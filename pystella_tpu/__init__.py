@@ -1,0 +1,86 @@
+"""pystella_tpu: a TPU-native framework for PDE systems on 3-D periodic
+lattices.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the reference
+pystella (/root/reference): symbolic field expressions, finite-difference and
+spectral operators, Runge-Kutta steppers, distributed 3-D lattices over
+device meshes, Fourier analysis (spectra, projections, Gaussian random
+fields), FLRW expansion, scalar-field / gravitational-wave sectors, and
+multigrid solvers.
+
+Where the reference generates OpenCL via loopy and communicates via MPI
+(/root/reference/pystella/__init__.py:24-40), here XLA is the kernel
+generator and compiler, lattices are ``jax.Array``s sharded over a
+``jax.sharding.Mesh``, and communication is XLA collectives over ICI/DCN.
+"""
+
+from pystella_tpu.field import (
+    Field, DynamicField, Expr, Var,
+    diff, simplify, substitute, evaluate, field_names,
+    exp, log, sin, cos, tan, sinh, cosh, tanh, sqrt, fabs, sign,
+    t, x, y, z,
+)
+from pystella_tpu.grid import Lattice
+from pystella_tpu.parallel import DomainDecomposition, make_mesh
+from pystella_tpu.ops import (
+    ElementWiseMap,
+    FirstCenteredDifference, SecondCenteredDifference, FiniteDifferencer,
+    Reduction, FieldStatistics,
+    Histogrammer, FieldHistogrammer,
+)
+from pystella_tpu.step import (
+    Stepper, RungeKuttaStepper, LowStorageRKStepper,
+    RungeKutta4, RungeKutta3Heun, RungeKutta3Nystrom, RungeKutta3Ralston,
+    RungeKutta3SSP, RungeKutta2Midpoint, RungeKutta2Heun, RungeKutta2Ralston,
+    LowStorageRK54, LowStorageRK144, LowStorageRK134, LowStorageRK124,
+    LowStorageRK3Williamson, LowStorageRK3Inhomogeneous,
+    LowStorageRK3Symmetric, LowStorageRK3PredictorCorrector, LowStorageRK3SSP,
+    all_steppers,
+)
+
+__version__ = "2026.1"
+
+
+def choose_device_and_make_context(platform=None):
+    """Parity shim for the reference device chooser
+    (/root/reference/pystella/__init__.py:46-102). With JAX there is no
+    context to create; returns ``(None, jax.devices()[0])``."""
+    import jax
+    devices = jax.devices(platform) if platform else jax.devices()
+    return None, devices[0]
+
+
+class DisableLogging:
+    """Context manager silencing logging (reference
+    /root/reference/pystella/__init__.py:105-114)."""
+
+    def __enter__(self):
+        import logging
+        self.previous_level = logging.root.manager.disable
+        logging.disable(logging.CRITICAL)
+
+    def __exit__(self, exception_type, exception_value, traceback):
+        import logging
+        logging.disable(self.previous_level)
+
+
+__all__ = [
+    "Field", "DynamicField", "Expr", "Var", "diff", "simplify", "substitute",
+    "evaluate", "field_names",
+    "exp", "log", "sin", "cos", "tan", "sinh", "cosh", "tanh", "sqrt",
+    "fabs", "sign", "t", "x", "y", "z",
+    "Lattice", "DomainDecomposition", "make_mesh",
+    "ElementWiseMap",
+    "FirstCenteredDifference", "SecondCenteredDifference",
+    "FiniteDifferencer",
+    "Reduction", "FieldStatistics", "Histogrammer", "FieldHistogrammer",
+    "Stepper", "RungeKuttaStepper", "LowStorageRKStepper",
+    "RungeKutta4", "RungeKutta3Heun", "RungeKutta3Nystrom",
+    "RungeKutta3Ralston", "RungeKutta3SSP", "RungeKutta2Midpoint",
+    "RungeKutta2Heun", "RungeKutta2Ralston",
+    "LowStorageRK54", "LowStorageRK144", "LowStorageRK134", "LowStorageRK124",
+    "LowStorageRK3Williamson", "LowStorageRK3Inhomogeneous",
+    "LowStorageRK3Symmetric", "LowStorageRK3PredictorCorrector",
+    "LowStorageRK3SSP", "all_steppers",
+    "choose_device_and_make_context", "DisableLogging",
+]

@@ -1,0 +1,14 @@
+from pystella_tpu.ops.elementwise import ElementWiseMap
+from pystella_tpu.ops.derivs import (
+    FirstCenteredDifference, SecondCenteredDifference, FiniteDifferencer,
+)
+from pystella_tpu.ops.reduction import Reduction, FieldStatistics
+from pystella_tpu.ops.histogram import Histogrammer, FieldHistogrammer
+
+__all__ = [
+    "ElementWiseMap",
+    "FirstCenteredDifference", "SecondCenteredDifference",
+    "FiniteDifferencer",
+    "Reduction", "FieldStatistics",
+    "Histogrammer", "FieldHistogrammer",
+]

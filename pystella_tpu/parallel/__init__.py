@@ -1,0 +1,3 @@
+from pystella_tpu.parallel.decomp import DomainDecomposition, make_mesh
+
+__all__ = ["DomainDecomposition", "make_mesh"]

@@ -1,0 +1,68 @@
+"""Test configuration: virtual multi-device CPU mesh.
+
+Mirrors the reference's strategy of running the same test bodies at several
+process-grid shapes (/root/reference/test/conftest.py:1-22 +
+.github/workflows/ci.yml:96-97, which reruns the suite under
+``mpirun -np 4 --proc_shape 2,2,1``). Here a single process fakes 8 CPU
+devices via ``--xla_force_host_platform_device_count`` and tests
+parametrize over mesh shapes, exercising the identical ``shard_map`` /
+``ppermute`` / ``psum`` code paths that run over ICI on a real TPU slice.
+"""
+
+import os
+
+# must run before jax initializes a backend
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "1"  # reference defaults to float64 accuracy
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip TPU-tunnel plugin
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# The container's sitecustomize registers a remote-TPU ("axon") PJRT plugin
+# at interpreter startup; merely querying jax.devices() would try to claim
+# the tunnel even under JAX_PLATFORMS=cpu. Tests run on the virtual CPU
+# mesh, so drop the factory before any backend is initialized.
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _name in ("axon", "tpu"):
+    _xb._backend_factories.pop(_name, None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # reference defaults to float64
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption("--grid_shape", action="store", default=None,
+                     help="comma-separated lattice shape, e.g. 32,32,32")
+    parser.addoption("--proc_shape", action="store", default=None,
+                     help="comma-separated mesh shape, e.g. 2,2,1")
+
+
+def _parse(opt, default):
+    if opt is None:
+        return default
+    return tuple(int(i) for i in opt.split(","))
+
+
+@pytest.fixture
+def grid_shape(request):
+    return _parse(request.config.getoption("--grid_shape"), (16, 16, 16))
+
+
+@pytest.fixture
+def proc_shape(request):
+    return _parse(request.config.getoption("--proc_shape"), (2, 2, 1))
+
+
+@pytest.fixture
+def decomp(proc_shape):
+    import jax
+    from pystella_tpu import DomainDecomposition
+    devices = jax.devices()[:int(np.prod(proc_shape))]
+    return DomainDecomposition(proc_shape, devices=devices)

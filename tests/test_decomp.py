@@ -1,0 +1,73 @@
+"""Domain decomposition tests (analog of /root/reference/test/test_decomp.py:
+halo exchange against the globally-periodic array; gather/scatter
+round-trips)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1), (2, 2, 2)],
+                         indirect=True)
+@pytest.mark.parametrize("h", [1, 2])
+def test_share_halos(decomp, grid_shape, proc_shape, h):
+    import jax
+    rng = np.random.default_rng(7)
+    host = rng.random(grid_shape)
+    arr = decomp.shard(host)
+
+    padded = decomp.share_halos(arr, h)
+
+    # every local shard must equal the wrap-padded slab of the global array
+    rank_shape = decomp.rank_shape(grid_shape)
+    padded_local = tuple(n + 2 * h for n in rank_shape)
+    for shard in padded.addressable_shards:
+        block_pos = tuple((s.start or 0) // p
+                          for s, p in zip(shard.index, padded_local))
+        expected_idx = tuple(
+            np.arange(b * n - h, (b + 1) * n + h) % g
+            for b, n, g in zip(block_pos, rank_shape, grid_shape))
+        expected = host[np.ix_(*expected_idx)]
+        assert np.array_equal(np.asarray(shard.data), expected), \
+            f"halo mismatch at block {block_pos}"
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1), (2, 2, 2)],
+                         indirect=True)
+def test_gather_scatter_roundtrip(decomp, grid_shape, proc_shape):
+    rng = np.random.default_rng(11)
+    host = rng.random(grid_shape)
+
+    arr = decomp.scatter_array(host)
+    assert arr.sharding.is_fully_addressable
+    back = decomp.gather_array(arr)
+    assert np.array_equal(back, host)
+
+    # with outer axes
+    host2 = rng.random((2,) + grid_shape)
+    arr2 = decomp.shard(host2)
+    assert np.array_equal(decomp.gather_array(arr2), host2)
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_allreduce(decomp, grid_shape, proc_shape):
+    rng = np.random.default_rng(3)
+    host = rng.random(grid_shape)
+    arr = decomp.shard(host)
+    assert np.isclose(float(decomp.allreduce(arr, "sum")), host.sum())
+    assert np.isclose(float(decomp.allreduce(arr, "max")), host.max())
+    assert np.isclose(float(decomp.allreduce(arr, "min")), host.min())
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_rank_shape(decomp, proc_shape):
+    assert decomp.rank_shape((16, 16, 16)) == (8, 8, 16)
+    with pytest.raises(ValueError):
+        decomp.rank_shape((15, 16, 16))
+
+
+def test_zeros_sharded(decomp, grid_shape):
+    arr = decomp.zeros(grid_shape, np.float32, outer_shape=(2,))
+    assert arr.shape == (2,) + grid_shape
+    assert float(arr.sum()) == 0.0

@@ -1,0 +1,120 @@
+"""Finite-difference tests against stencil eigenvalues on plane waves
+(analog of /root/reference/test/test_derivs.py:53-135)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+
+
+def make_plane_wave(grid_shape, box_dim, modes, dtype=np.float64):
+    lattice = ps.Lattice(grid_shape, box_dim, dtype=dtype)
+    xs = [np.arange(n) * d for n, d in zip(grid_shape, lattice.dx)]
+    X, Y, Z = np.meshgrid(*xs, indexing="ij")
+    kx, ky, kz = [m * dk for m, dk in zip(modes, lattice.dk)]
+    phase = kx * X + ky * Y + kz * Z
+    return lattice, np.sin(phase).astype(dtype), np.cos(phase).astype(dtype), \
+        (kx, ky, kz)
+
+
+@pytest.mark.parametrize("h", [1, 2, 3, 4])
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 2)], indirect=True)
+def test_gradient_eigenvalues(decomp, grid_shape, proc_shape, h):
+    lattice, f, cosph, (kx, ky, kz) = make_plane_wave(
+        grid_shape, (5.0, 4.0, 7.0), (2, 3, 1))
+    fd = ps.FiniteDifferencer(decomp, h, lattice.dx)
+
+    arr = decomp.shard(f.astype(np.float64))
+    grd = np.asarray(fd.grad(arr))
+
+    stencil = ps.FirstCenteredDifference(h)
+    for d, k in enumerate((kx, ky, kz)):
+        eff_k = stencil.get_eigenvalues(k, lattice.dx[d])
+        expected = eff_k * cosph
+        err = np.max(np.abs(grd[d] - expected))
+        scale = max(np.max(np.abs(expected)), 1e-10)
+        assert err / scale < 1e-11, f"axis {d}, h={h}: rel err {err/scale}"
+
+
+@pytest.mark.parametrize("h", [1, 2, 3, 4])
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 2)], indirect=True)
+def test_laplacian_eigenvalues(decomp, grid_shape, proc_shape, h):
+    lattice, f, _, (kx, ky, kz) = make_plane_wave(
+        grid_shape, (5.0, 4.0, 7.0), (2, 3, 1))
+    fd = ps.FiniteDifferencer(decomp, h, lattice.dx)
+
+    arr = decomp.shard(f.astype(np.float64))
+    lap = np.asarray(fd.lap(arr))
+
+    stencil = ps.SecondCenteredDifference(h)
+    eig = sum(stencil.get_eigenvalues(k, dx)
+              for k, dx in zip((kx, ky, kz), lattice.dx))
+    expected = eig * f
+    err = np.max(np.abs(lap - expected))
+    scale = max(np.max(np.abs(expected)), 1e-10)
+    assert err / scale < 1e-11, f"h={h}: rel err {err/scale}"
+
+
+@pytest.mark.parametrize("h", [1, 2])
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_grad_lap_fused_matches(decomp, grid_shape, proc_shape, h):
+    rng = np.random.default_rng(5)
+    f = rng.random(grid_shape)
+    lattice = ps.Lattice(grid_shape, (1.0, 1.0, 1.0))
+    fd = ps.FiniteDifferencer(decomp, h, lattice.dx)
+    arr = decomp.shard(f)
+
+    grd, lap = fd.grad_lap(arr)
+    assert np.allclose(np.asarray(grd), np.asarray(fd.grad(arr)), atol=1e-12)
+    assert np.allclose(np.asarray(lap), np.asarray(fd.lap(arr)), atol=1e-12)
+
+
+@pytest.mark.parametrize("h", [1, 2])
+@pytest.mark.parametrize("proc_shape", [(2, 2, 2)], indirect=True)
+def test_divergence(decomp, grid_shape, proc_shape, h):
+    lattice, f, cosph, (kx, ky, kz) = make_plane_wave(
+        grid_shape, (3.0, 4.0, 5.0), (1, 2, 2))
+    fd = ps.FiniteDifferencer(decomp, h, lattice.dx)
+
+    vec = np.stack([f, 2 * f, 3 * f])
+    arr = decomp.shard(vec)
+    div = np.asarray(fd.divergence(arr))
+
+    stencil = ps.FirstCenteredDifference(h)
+    expected = sum(c * stencil.get_eigenvalues(k, dx) * cosph
+                   for c, k, dx in zip((1, 2, 3), (kx, ky, kz), lattice.dx))
+    err = np.max(np.abs(div - expected))
+    scale = max(np.max(np.abs(expected)), 1e-10)
+    assert err / scale < 1e-11
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_outer_axes(decomp, grid_shape, proc_shape):
+    rng = np.random.default_rng(9)
+    f = rng.random((2,) + grid_shape)
+    lattice = ps.Lattice(grid_shape, (1.0, 1.0, 1.0))
+    fd = ps.FiniteDifferencer(decomp, 2, lattice.dx)
+    arr = decomp.shard(f)
+
+    lap = np.asarray(fd.lap(arr))
+    for i in range(2):
+        single = np.asarray(fd.lap(decomp.shard(f[i])))
+        assert np.allclose(lap[i], single, atol=1e-12)
+
+    grd = np.asarray(fd.grad(arr))
+    assert grd.shape == (2, 3) + grid_shape
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1)], indirect=True)
+def test_roll_mode_matches_halo_mode(decomp, grid_shape, proc_shape):
+    rng = np.random.default_rng(13)
+    f = rng.random(grid_shape)
+    lattice = ps.Lattice(grid_shape, (1.0, 1.0, 1.0))
+    fd_halo = ps.FiniteDifferencer(decomp, 2, lattice.dx, mode="halo")
+    fd_roll = ps.FiniteDifferencer(decomp, 2, lattice.dx, mode="roll")
+    arr = decomp.shard(f)
+
+    assert np.allclose(np.asarray(fd_halo.lap(arr)),
+                       np.asarray(fd_roll.lap(arr)), atol=1e-12)
+    assert np.allclose(np.asarray(fd_halo.grad(arr)),
+                       np.asarray(fd_roll.grad(arr)), atol=1e-12)

@@ -1,0 +1,149 @@
+"""Symbolic field layer tests (analog of /root/reference/test/test_field.py:
+Field algebra, differentiation, substitution round-trips)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+from pystella_tpu.field import Constant, evaluate
+
+
+def test_field_algebra_evaluates():
+    f = ps.Field("f")
+    g = ps.Field("g")
+    expr = 2 * f + g ** 2 - f * g / 4 + 3
+
+    env = {"f": np.float64(1.5), "g": np.float64(2.0)}
+    expected = 2 * 1.5 + 4.0 - 1.5 * 2.0 / 4 + 3
+    assert np.isclose(evaluate(expr, env), expected)
+
+
+def test_field_arrays_broadcast():
+    f = ps.Field("f")
+    rng = np.random.default_rng(42)
+    arr = rng.random((4, 4, 4))
+    env = {"f": arr}
+    out = evaluate(3 * f ** 2 - 1, env)
+    assert np.allclose(out, 3 * arr ** 2 - 1)
+
+
+def test_indexed_fields():
+    f = ps.Field("f", shape=(2,))
+    expr = f[0] * f[1]
+    env = {"f": np.array([[3.0], [4.0]])}
+    assert np.isclose(evaluate(expr, env), 12.0)
+
+    # iteration over components
+    total = sum(fi for fi in f)
+    assert np.isclose(evaluate(total, env), 7.0)
+
+
+def test_dynamic_field_members():
+    f = ps.DynamicField("phi")
+    assert f.dot.name == "dphidt"
+    assert f.lap.name == "lap_phi"
+    assert f.pd.name == "dphidx"
+    assert f.pd.shape == (3,)
+    assert f.d(0) == f.dot
+    assert f.d(1) == f.pd[0]
+    assert f.d(3) == f.pd[2]
+
+    g = ps.DynamicField("chi", shape=(2,))
+    assert g.d(1, 0) == g.dot[1]
+    assert g.d(0, 2) == g.pd[0, 1]
+
+
+@pytest.mark.parametrize("n", [2, 3, 4])
+def test_diff_powers(n):
+    f = ps.Field("f")
+    d = ps.diff(f ** n, f)
+    val = 1.7
+    assert np.isclose(evaluate(d, {"f": val}), n * val ** (n - 1))
+
+
+def test_diff_functions():
+    f = ps.Field("f")
+    checks = [
+        (ps.exp(f), lambda v: np.exp(v)),
+        (ps.sin(f), lambda v: np.cos(v)),
+        (ps.cos(f), lambda v: -np.sin(v)),
+        (ps.tanh(f), lambda v: 1 - np.tanh(v) ** 2),
+        (ps.log(f), lambda v: 1 / v),
+        (ps.sqrt(f), lambda v: 0.5 / np.sqrt(v)),
+    ]
+    val = 0.73
+    for expr, expect in checks:
+        d = ps.diff(expr, f)
+        assert np.isclose(evaluate(d, {"f": val}), expect(val)), expr
+
+
+def test_diff_chain_and_product():
+    f, g = ps.Field("f"), ps.Field("g")
+    expr = f ** 2 * ps.exp(-g * f)
+    df = ps.diff(expr, f)
+    fv, gv = 1.3, 0.4
+    expected = 2 * fv * np.exp(-gv * fv) - gv * fv ** 2 * np.exp(-gv * fv)
+    assert np.isclose(evaluate(df, {"f": fv, "g": gv}), expected)
+
+
+def test_diff_multiple_vars():
+    f, g = ps.Field("f"), ps.Field("g")
+    expr = f ** 2 * g ** 3
+    d2 = ps.diff(expr, f, g)
+    fv, gv = 1.1, 0.9
+    assert np.isclose(evaluate(d2, {"f": fv, "g": gv}),
+                      2 * fv * 3 * gv ** 2)
+
+
+def test_diff_wrt_indexed():
+    f = ps.Field("f", shape=(2,))
+    V = f[0] ** 2 * f[1]
+    d0 = ps.diff(V, f[0])
+    d1 = ps.diff(V, f[1])
+    env = {"f": np.array([2.0, 5.0])}
+    assert np.isclose(evaluate(d0, env), 2 * 2.0 * 5.0)
+    assert np.isclose(evaluate(d1, env), 4.0)
+
+
+def test_coordinate_diff_maps_to_dot_and_pd():
+    f = ps.DynamicField("f")
+    assert ps.diff(f, ps.t) == f.dot
+    assert ps.diff(f, ps.x) == f.pd[0]
+    assert ps.diff(f, ps.z) == f.pd[2]
+
+    # chain rule through a potential
+    expr = ps.diff(f ** 2, ps.t)
+    env = {"f": 3.0, "dfdt": 0.5}
+    assert np.isclose(evaluate(expr, env), 2 * 3.0 * 0.5)
+
+    # explicit coordinate dependence: d(t*f)/dt = f + t*dfdt
+    assert np.isclose(evaluate(ps.diff(ps.t, ps.t), {}), 1.0)
+    expr = ps.diff(ps.t * f, ps.t)
+    env = {"f": 3.0, "dfdt": 0.5, "t": 2.0}
+    assert np.isclose(evaluate(expr, env), 3.0 + 2.0 * 0.5)
+
+
+def test_substitute():
+    f, g = ps.Field("f"), ps.Field("g")
+    expr = f ** 2 + g
+    swapped = ps.substitute(expr, {g: f})
+    assert np.isclose(evaluate(swapped, {"f": 2.0}), 6.0)
+
+
+def test_simplify_constant_folding():
+    f = ps.Field("f")
+    expr = ps.simplify(0 * f + 2 * 3 + f ** 1)
+    assert np.isclose(evaluate(expr, {"f": 1.0}), 7.0)
+
+
+def test_field_hash_eq():
+    assert ps.Field("f") == ps.Field("f")
+    assert ps.Field("f") != ps.Field("g")
+    d = {ps.Field("f"): 1}
+    assert d[ps.Field("f")] == 1
+
+
+def test_field_names():
+    f = ps.DynamicField("f")
+    names = ps.field_names(f.lap - 2 * f.dot + f ** 2)
+    assert names == {"lap_f", "dfdt", "f"}
