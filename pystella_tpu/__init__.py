@@ -28,6 +28,11 @@ from pystella_tpu.ops import (
     Reduction, FieldStatistics,
     Histogrammer, FieldHistogrammer,
 )
+from pystella_tpu.fourier import (
+    DFT, fftfreq, pfftfreq, make_hermitian,
+    Projector, PowerSpectra, RayleighGenerator,
+    SpectralCollocator, SpectralPoissonSolver,
+)
 from pystella_tpu.step import (
     Stepper, RungeKuttaStepper, LowStorageRKStepper,
     RungeKutta4, RungeKutta3Heun, RungeKutta3Nystrom, RungeKutta3Ralston,
@@ -74,6 +79,9 @@ __all__ = [
     "FirstCenteredDifference", "SecondCenteredDifference",
     "FiniteDifferencer",
     "Reduction", "FieldStatistics", "Histogrammer", "FieldHistogrammer",
+    "DFT", "fftfreq", "pfftfreq", "make_hermitian",
+    "Projector", "PowerSpectra", "RayleighGenerator",
+    "SpectralCollocator", "SpectralPoissonSolver",
     "Stepper", "RungeKuttaStepper", "LowStorageRKStepper",
     "RungeKutta4", "RungeKutta3Heun", "RungeKutta3Nystrom",
     "RungeKutta3Ralston", "RungeKutta3SSP", "RungeKutta2Midpoint",

@@ -1,0 +1,17 @@
+from pystella_tpu.fourier.dft import (
+    DFT, fftfreq, pfftfreq, make_hermitian,
+    get_real_dtype_with_matching_prec, get_complex_dtype_with_matching_prec,
+)
+from pystella_tpu.fourier.projectors import Projector, tensor_index
+from pystella_tpu.fourier.spectra import PowerSpectra
+from pystella_tpu.fourier.rayleigh import RayleighGenerator
+from pystella_tpu.fourier.derivs import SpectralCollocator
+from pystella_tpu.fourier.poisson import SpectralPoissonSolver
+
+__all__ = [
+    "DFT", "fftfreq", "pfftfreq", "make_hermitian",
+    "get_real_dtype_with_matching_prec",
+    "get_complex_dtype_with_matching_prec",
+    "Projector", "tensor_index", "PowerSpectra", "RayleighGenerator",
+    "SpectralCollocator", "SpectralPoissonSolver",
+]

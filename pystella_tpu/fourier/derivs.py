@@ -1,0 +1,130 @@
+"""Spectral-collocation derivatives.
+
+TPU-native counterpart of /root/reference/pystella/fourier/derivs.py:28-205:
+the same interface as :class:`~pystella_tpu.FiniteDifferencer`, computing
+derivatives by FFT → multiply by ``i k`` (Nyquist modes zeroed for odd
+derivatives) or ``-k²`` → inverse FFT. Because :meth:`DFT.idft` is already
+normalized, no manual ``1/grid_size`` factor is needed (unlike
+derivs.py:78-79).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SpectralCollocator"]
+
+
+class SpectralCollocator:
+    """Spectral derivatives of sharded lattice fields (functional: returns
+    new arrays).
+
+    :arg fft: a :class:`~pystella_tpu.fourier.DFT`.
+    :arg dk: momentum-space grid spacing per axis.
+    """
+
+    def __init__(self, fft, dk, **kwargs):
+        self.fft = fft
+        self.decomp = fft.decomp
+        rdtype = fft.rdtype
+
+        decomp = fft.decomp
+        self._k1 = []  # first-derivative momenta (zero & Nyquist zeroed)
+        self._k2 = []  # second-derivative momenta
+        for mu, kk in enumerate(fft.sub_k.values()):
+            kk_int = kk.astype(int)
+            k2 = (dk[mu] * kk.astype(rdtype))
+            k1 = k2.copy()
+            k1[np.abs(kk_int) == fft.grid_shape[mu] // 2] = 0.0
+            k1[kk_int == 0] = 0.0
+            self._k1.append(decomp.axis_array(mu, k1))
+            self._k2.append(decomp.axis_array(mu, k2))
+
+        self._lap = jax.jit(self._lap_impl)
+        self._grad = jax.jit(self._grad_impl)
+        self._grad_lap = jax.jit(self._grad_lap_impl)
+        self._pd = jax.jit(self._pd_impl, static_argnums=1)
+        self._div = jax.jit(self._div_impl)
+
+    def _lap_impl(self, fx):
+        fk = self.fft._dft_impl(fx)
+        ksq = sum(k * k for k in self._k2)
+        return self.fft._idft_impl(-ksq * fk).astype(fx.dtype)
+
+    def _pd_impl(self, fx, mu):
+        fk = self.fft._dft_impl(fx)
+        return self.fft._idft_impl(1j * self._k1[mu] * fk).astype(fx.dtype)
+
+    def _grad_impl(self, fx):
+        fk = self.fft._dft_impl(fx)
+        la = fx.ndim - 3
+        return jnp.stack(
+            [self.fft._idft_impl(1j * self._k1[mu] * fk).astype(fx.dtype)
+             for mu in range(3)], axis=la)
+
+    def _grad_lap_impl(self, fx):
+        fk = self.fft._dft_impl(fx)
+        la = fx.ndim - 3
+        grd = jnp.stack(
+            [self.fft._idft_impl(1j * self._k1[mu] * fk).astype(fx.dtype)
+             for mu in range(3)], axis=la)
+        ksq = sum(k * k for k in self._k2)
+        lap = self.fft._idft_impl(-ksq * fk).astype(fx.dtype)
+        return grd, lap
+
+    def _div_impl(self, vec):
+        # sum the i*k_mu-weighted spectra in k-space: one inverse FFT
+        # instead of three (the forward transforms batch over the
+        # component axis)
+        fk = self.fft._dft_impl(vec)
+        la = fk.ndim - 4
+        div_k = sum(1j * self._k1[mu] * jnp.take(fk, mu, axis=la)
+                    for mu in range(3))
+        return self.fft._idft_impl(div_k).astype(vec.dtype)
+
+    # -- public interface (mirrors FiniteDifferencer) ----------------------
+    # calls enter the mesh context: the pencil reshards trace inside
+
+    def lap(self, f):
+        with self.fft._with_mesh():
+            return self._lap(f)
+
+    def grad(self, f):
+        with self.fft._with_mesh():
+            return self._grad(f)
+
+    def grad_lap(self, f):
+        with self.fft._with_mesh():
+            return self._grad_lap(f)
+
+    def pdx(self, f):
+        with self.fft._with_mesh():
+            return self._pd(f, 0)
+
+    def pdy(self, f):
+        with self.fft._with_mesh():
+            return self._pd(f, 1)
+
+    def pdz(self, f):
+        with self.fft._with_mesh():
+            return self._pd(f, 2)
+
+    def divergence(self, vec):
+        with self.fft._with_mesh():
+            return self._div(vec)
+
+    def __call__(self, fx, *, lap=False, grd=False, div=False):
+        out = {}
+        if lap and grd:
+            g, lp = self.grad_lap(fx)
+            out["grd"], out["lap"] = g, lp
+        elif lap:
+            out["lap"] = self.lap(fx)
+        elif grd:
+            out["grd"] = self.grad(fx)
+        if div:
+            out["div"] = self.divergence(fx)
+        return out

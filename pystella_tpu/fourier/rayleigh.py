@@ -1,0 +1,176 @@
+"""Gaussian random field initialization in k-space.
+
+TPU-native counterpart of /root/reference/pystella/fourier/rayleigh.py:
+35-395: draws Rayleigh-distributed mode amplitudes with uniform phases for a
+chosen power spectrum, imposes the Hermitian symmetry of real fields, and
+inverse-transforms. Uses ``jax.random`` (Threefry — the same counter-based
+generator family the reference uses via pyopencl.clrandom, rayleigh.py:154).
+
+Mode generation happens once at setup on the host-resident k-grid (the
+Hermitian symmetrization is index-irregular and cheap there); the resulting
+fields are sharded device arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from pystella_tpu.fourier.dft import make_hermitian
+
+__all__ = ["RayleighGenerator"]
+
+
+class RayleighGenerator:
+    """Generate Gaussian-random fields with a chosen power spectrum.
+
+    :arg context: unused (API parity with the reference's pyopencl context).
+    :arg fft: a :class:`~pystella_tpu.fourier.DFT`.
+    :arg dk: momentum-space grid spacing per axis.
+    :arg volume: physical grid volume.
+    :arg seed: RNG seed (default 13298, like the reference).
+    """
+
+    def __init__(self, context=None, fft=None, dk=None, volume=None,
+                 seed=13298):
+        if fft is None:
+            raise ValueError("fft is required")
+        self.fft = fft
+        self.dtype = fft.dtype
+        self.rdtype = fft.rdtype
+        self.cdtype = fft.cdtype
+        self.volume = volume
+
+        sub_k = list(fft.sub_k.values())
+        kvecs = np.meshgrid(*sub_k, indexing="ij", sparse=False)
+        self.kmags = np.sqrt(sum((dki * ki)**2
+                                 for dki, ki in zip(dk, kvecs)))
+        # generated modes are in *unnormalized-forward-FFT* convention (the
+        # convention PowerSpectra assumes), so fft.idft — which is normalized,
+        # unlike the reference's raw FFTW backward (dft.py:424-427) — yields
+        # the same physical field the reference produces
+        self.grid_size = float(np.prod(fft.grid_shape))
+        self.key = jax.random.key(seed)
+
+    def _next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    def _uniform(self, n):
+        """n independent uniform(0, 1) arrays over the k-grid (host)."""
+        u = jax.random.uniform(
+            self._next_key(), (n,) + self.kmags.shape,
+            dtype=np.float64 if jax.config.jax_enable_x64 else np.float32,
+            minval=np.finfo(np.float32).tiny, maxval=1.0)
+        return np.asarray(jax.device_get(u)).astype(self.rdtype)
+
+    def _post_process(self, fk):
+        if self.fft.is_real:
+            fk = make_hermitian(fk)
+            fk = self.fft.zero_corner_modes(fk, only_imag=True)
+        return fk
+
+    def _ps_wrapper(self, ps_func, wk, kmags):
+        """Evaluate a power spectrum, protecting the k=0 mode (reference
+        rayleigh.py:172-183)."""
+        found_zero = kmags[0, 0, 0] == 0.0
+        wk = np.array(wk)
+        if found_zero:
+            wk0 = wk[0, 0, 0]
+            wk[0, 0, 0] = wk[0, 0, 1]
+        power = np.asarray(ps_func(wk), self.rdtype)
+        if found_zero:
+            power = np.array(power)
+            power[0, 0, 0] = 0.0
+            wk[0, 0, 0] = wk0
+        return power
+
+    def generate(self, queue=None, random=True,
+                 field_ps=lambda kmag: 1 / 2 / kmag,
+                 norm=1, window=lambda kmag: 1.0):
+        """Generate Fourier modes with power spectrum ``field_ps`` and
+        random phases (reference rayleigh.py:185-226).
+
+        :returns: host ``np.ndarray`` of modes (pass through
+            ``fft.idft`` / :meth:`init_field` for the position-space field).
+        """
+        amplitude_sq = norm / self.volume * self.grid_size**2
+        rands = self._uniform(2)
+        if not random:
+            rands[0] = np.exp(-1)
+
+        f_power = (amplitude_sq * window(self.kmags)**2
+                   * self._ps_wrapper(field_ps, self.kmags, self.kmags))
+
+        amp = np.sqrt(-np.log(rands[0]))
+        phs = np.exp(2j * np.pi * rands[1]).astype(self.cdtype)
+        fk = phs * amp * np.sqrt(f_power)
+        return self._post_process(fk)
+
+    def init_field(self, fx=None, queue=None, **kwargs):
+        """Initialize a position-space field with :meth:`generate`'s modes;
+        returns the sharded device array (reference rayleigh.py:228-245
+        fills the passed array instead)."""
+        fk = self.generate(**kwargs)
+        return self.fft.idft(fk)
+
+    def init_transverse_vector(self, projector, vector=None, queue=None,
+                               **kwargs):
+        """Initialize a transverse 3-vector field (same power spectrum per
+        component); returns the ``(3,) + grid_shape`` array (reference
+        rayleigh.py:247-278)."""
+        vector_k = np.stack([self.generate(**kwargs) for _ in range(3)])
+        vector_k = projector.transversify(self.fft.decomp.shard(vector_k))
+        return self.fft.idft(vector_k)
+
+    def init_vector_from_pol(self, projector, vector=None, plus_ps=None,
+                             minus_ps=None, queue=None, **kwargs):
+        """Initialize a transverse vector from polarization spectra
+        (reference rayleigh.py:280-323)."""
+        plus_k = self.fft.decomp.shard(
+            self.generate(field_ps=plus_ps, **kwargs))
+        minus_k = self.fft.decomp.shard(
+            self.generate(field_ps=minus_ps, **kwargs))
+        vector_k = projector.pol_to_vec(plus_k, minus_k)
+        return self.fft.idft(vector_k)
+
+    def generate_WKB(self, queue=None, random=True,
+                     field_ps=lambda wk: 1 / 2 / wk,
+                     norm=1, omega_k=lambda kmag: kmag,
+                     hubble=0.0, window=lambda kmag: 1.0):
+        """Generate modes for a field and its conformal-time derivative in
+        the WKB approximation (reference rayleigh.py:325-373):
+        left/right-moving modes with dispersion ``omega_k`` and Hubble drag,
+        ``dfk = i ω (L - R)/√2 - H fk``.
+
+        :returns: host ``(fk, dfk)`` arrays.
+        """
+        amplitude_sq = norm / self.volume * self.grid_size**2
+        rands = self._uniform(4)
+        if not random:
+            rands[0] = rands[2] = np.exp(-1)
+
+        wk = np.asarray(omega_k(self.kmags), self.rdtype)
+        f_power = (amplitude_sq * window(self.kmags)**2
+                   * self._ps_wrapper(field_ps, wk, self.kmags))
+
+        amp1 = np.sqrt(-np.log(rands[0]))
+        amp2 = np.sqrt(-np.log(rands[2]))
+        phs1 = np.exp(2j * np.pi * rands[1]).astype(self.cdtype)
+        phs2 = np.exp(2j * np.pi * rands[3]).astype(self.cdtype)
+
+        sqrt_power = np.sqrt(f_power)
+        lmode = phs1 * amp1 * sqrt_power
+        rmode = phs2 * amp2 * sqrt_power
+        rt2 = np.sqrt(2.0)
+        fk = (lmode + rmode) / rt2
+        dfk = 1j * wk * (lmode - rmode) / rt2 - hubble * fk
+
+        return self._post_process(fk), self._post_process(dfk)
+
+    def init_WKB_fields(self, fx=None, dfx=None, queue=None, **kwargs):
+        """Initialize a field and its time derivative via WKB modes; returns
+        ``(fx, dfx)`` sharded arrays (reference rayleigh.py:375-395)."""
+        fk, dfk = self.generate_WKB(**kwargs)
+        return self.fft.idft(fk), self.fft.idft(dfk)

@@ -1,0 +1,174 @@
+"""Radially-binned power spectra.
+
+TPU-native counterpart of /root/reference/pystella/fourier/spectra.py:29-419.
+The reference bins ``|f(k)|²`` with an atomic histogram kernel plus MPI
+allreduce; here the binned sums are per-device ``jnp.bincount``s inside
+``shard_map`` reduced with ``lax.psum`` (deterministic, no atomics). All
+conventions are preserved: bin index ``round(|k| / bin_width)``, r2c
+double-count weighting (2 except on the ``kz ∈ {0, Nyquist}`` planes,
+spectra.py:81-87,112-119), bin-count normalization, and the overall
+``1/(2π²V) · (d³x)²`` normalization (spectra.py:74-75).
+"""
+
+from __future__ import annotations
+
+from itertools import product
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pystella_tpu.fourier.projectors import tensor_index
+
+__all__ = ["PowerSpectra"]
+
+
+class PowerSpectra:
+    """Power spectra of scalar, vector, and tensor fields.
+
+    :arg decomp: a :class:`~pystella_tpu.DomainDecomposition`.
+    :arg fft: a :class:`~pystella_tpu.fourier.DFT`.
+    :arg dk: momentum-space grid spacing per axis.
+    :arg volume: physical grid volume.
+    :arg bin_width: defaults to ``min(dk)``.
+    """
+
+    def __init__(self, decomp, fft, dk, volume, **kwargs):
+        self.decomp = decomp
+        self.fft = fft
+        self.grid_shape = fft.grid_shape
+        self.dtype = fft.dtype
+        self.rdtype = fft.rdtype
+        self.cdtype = fft.cdtype
+        self.kshape = fft.shape(True)
+        self.dk = dk
+        self.bin_width = kwargs.pop("bin_width", min(dk))
+
+        d3x = volume / np.prod(self.grid_shape)
+        self.norm = (1 / 2 / np.pi**2 / volume) * d3x**2
+
+        sub_k = list(fft.sub_k.values())
+        kvecs = np.meshgrid(*sub_k, indexing="ij", sparse=False)
+        kmags = np.sqrt(sum((dki * ki)**2 for dki, ki in zip(self.dk, kvecs)))
+
+        if fft.is_real:
+            counts = 2.0 * np.ones_like(kmags)
+            counts[kvecs[2] == 0] = 1.0
+            counts[kvecs[2] == self.grid_shape[-1] // 2] = 1.0
+        else:
+            counts = np.ones_like(kmags)
+
+        max_k = np.max(kmags)
+        self.num_bins = int(max_k / self.bin_width + 0.5) + 1
+        bins = np.arange(-0.5, self.num_bins + 0.5) * self.bin_width
+        self.bin_counts = np.histogram(kmags, weights=counts, bins=bins)[0]
+
+        # device-side bin indices and count weights, sharded like k-space
+        spec = decomp.spec(0)
+        from jax.sharding import NamedSharding
+        sharding = NamedSharding(decomp.mesh, spec)
+        bin_idx = np.round(kmags / self.bin_width).astype(np.int32)
+        self._bin_idx = jax.device_put(bin_idx, sharding)
+        self._counts = jax.device_put(
+            counts.astype(self.rdtype), sharding)
+        self._kmags = jax.device_put(
+            kmags.astype(self.rdtype), sharding)
+
+        num_bins = self.num_bins
+
+        def local_hist(bins_, weights):
+            h = jnp.bincount(bins_.ravel(), weights=weights.ravel(),
+                             length=num_bins)
+            return decomp.psum(h)
+
+        from jax.sharding import PartitionSpec as P
+
+        def bin_power_impl(fk, k_power):
+            weight = (self._counts * self._kmags**k_power
+                      * jnp.abs(fk)**2)
+            hist = decomp.shard_map(
+                local_hist, (spec, spec), P())(self._bin_idx, weight)
+            return hist / self.bin_counts
+
+        self._bin_power = jax.jit(bin_power_impl)
+
+    def bin_power(self, fk, queue=None, k_power=3, allocator=None):
+        """Unnormalized binned power spectrum of a momentum-space field,
+        weighted by ``|k|**k_power`` (reference spectra.py:140-175)."""
+        if isinstance(fk, np.ndarray):
+            fk = self.decomp.shard(fk)
+        return np.asarray(self._bin_power(fk, k_power))
+
+    def __call__(self, fx, queue=None, k_power=3, allocator=None):
+        """Power spectrum Δ²_f(k) of a position-space field; outer axes are
+        looped over (reference spectra.py:177-226)."""
+        outer_shape = fx.shape[:-3]
+        slices = list(product(*[range(n) for n in outer_shape]))
+
+        result = np.zeros(outer_shape + (self.num_bins,), self.rdtype)
+        for s in slices:
+            fk = self.fft.dft(fx[s])
+            result[s] = self.bin_power(fk, k_power=k_power)
+        return self.norm * result
+
+    def polarization(self, vector, projector, queue=None, k_power=3,
+                     allocator=None):
+        """Spectra of the plus/minus polarizations of a vector field;
+        returns shape ``vector.shape[:-4] + (2, num_bins)``
+        (reference spectra.py:228-271)."""
+        outer_shape = vector.shape[:-4]
+        slices = list(product(*[range(n) for n in outer_shape]))
+
+        result = np.zeros(outer_shape + (2, self.num_bins), self.rdtype)
+        for s in slices:
+            vec_k = self.fft.dft(vector[s])
+            plus, minus = projector.vec_to_pol(vec_k)
+            result[s][0] = self.bin_power(plus, k_power=k_power)
+            result[s][1] = self.bin_power(minus, k_power=k_power)
+        return self.norm * result
+
+    def vector_decomposition(self, vector, projector, queue=None, k_power=3,
+                             allocator=None):
+        """Spectra of the plus/minus polarizations and longitudinal
+        component; returns ``vector.shape[:-4] + (3, num_bins)``
+        (reference spectra.py:273-320)."""
+        outer_shape = vector.shape[:-4]
+        slices = list(product(*[range(n) for n in outer_shape]))
+
+        result = np.zeros(outer_shape + (3, self.num_bins), self.rdtype)
+        for s in slices:
+            vec_k = self.fft.dft(vector[s])
+            plus, minus, lng = projector.decompose_vector(
+                vec_k, times_abs_k=True)
+            result[s][0] = self.bin_power(plus, k_power=k_power)
+            result[s][1] = self.bin_power(minus, k_power=k_power)
+            result[s][2] = self.bin_power(lng, k_power=k_power)
+        return self.norm * result
+
+    def gw(self, hij, projector, hubble, queue=None, k_power=3,
+           allocator=None):
+        """Spectral abundance Δ²_h(k) of transverse-traceless gravitational
+        waves from the (6,)-packed tensor ``hij`` (reference
+        spectra.py:322-370)."""
+        hij_k = self.fft.dft(hij)
+        hij_tt = projector.transverse_traceless(hij_k)
+
+        gw_spec = [self.bin_power(hij_tt[mu], k_power=k_power)
+                   for mu in range(6)]
+        gw_tot = sum(gw_spec[tensor_index(i, j)]
+                     for i in range(1, 4) for j in range(1, 4))
+        return self.norm / 12 / hubble**2 * gw_tot
+
+    def gw_polarization(self, hij, projector, hubble, queue=None, k_power=3,
+                        allocator=None):
+        """GW spectral abundance decomposed onto circular polarizations;
+        returns shape ``(2, num_bins)`` (reference spectra.py:372-419)."""
+        hij_k = self.fft.dft(hij)
+        plus, minus = projector.tensor_to_pol(hij_k)
+
+        result = np.zeros((2, self.num_bins), self.rdtype)
+        result[0] = self.bin_power(plus, k_power=k_power)
+        result[1] = self.bin_power(minus, k_power=k_power)
+        return self.norm / 12 / hubble**2 * result

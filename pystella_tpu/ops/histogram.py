@@ -43,13 +43,12 @@ class Histogrammer:
         self.num_bins = int(num_bins)
         self.dtype = dtype
 
-        mesh_names = tuple(decomp.mesh.axis_names)
         num_bins_ = self.num_bins
 
         def local_hist(bins, weights):
             h = jnp.bincount(bins.ravel(), weights=weights.ravel(),
                              length=num_bins_)
-            return lax.psum(h, mesh_names)
+            return decomp.psum(h)
 
         self._local_hist = local_hist
 

@@ -33,7 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["DomainDecomposition", "make_mesh"]
 
@@ -53,7 +53,10 @@ def make_mesh(proc_shape=None, axis_names=("x", "y", "z"), devices=None):
         raise ValueError(
             f"proc_shape {proc_shape} does not cover {len(devices)} devices")
     mesh_devices = np.asarray(devices).reshape(proc_shape)
-    return Mesh(mesh_devices, axis_names[:len(proc_shape)])
+    # Explicit axis types: required by the declarative pencil-FFT reshards
+    # (jax.sharding.reshard refuses Auto axes)
+    return Mesh(mesh_devices, axis_names[:len(proc_shape)],
+                axis_types=(AxisType.Explicit,) * len(proc_shape))
 
 
 class DomainDecomposition:
@@ -86,6 +89,32 @@ class DomainDecomposition:
 
     def sharding(self, outer_axes=0):
         return NamedSharding(self.mesh, self.spec(outer_axes))
+
+    @property
+    def reduce_axes(self):
+        """Mesh axis names lattice arrays are actually sharded over (size-1
+        axes excluded) — the axes to ``psum`` over inside ``shard_map``."""
+        return tuple(n for i, n in enumerate(self.axis_names)
+                     if self.proc_shape[i] > 1)
+
+    def psum(self, x):
+        """``lax.psum`` over all sharded mesh axes; no-op on a single-device
+        mesh. For use inside ``shard_map`` bodies."""
+        names = self.reduce_axes
+        return lax.psum(x, names) if names else x
+
+    def axis_array(self, mu, values):
+        """Device array of per-axis constants (momenta, stencil eigenvalues)
+        shaped ``(1, .., len(values), .., 1)`` for broadcasting against
+        lattice arrays, sharded to match lattice axis ``mu``."""
+        values = np.asarray(values)
+        shape = [1] * len(self.axis_names)
+        shape[mu] = len(values)
+        spec = [None] * len(self.axis_names)
+        if self.proc_shape[mu] > 1:
+            spec[mu] = self.axis_names[mu]
+        return jax.device_put(values.reshape(shape),
+                              NamedSharding(self.mesh, P(*spec)))
 
     def shard(self, array, outer_axes=None):
         """Place ``array`` (host or device) with lattice axes sharded over

@@ -52,11 +52,15 @@ def _parse(opt, default):
 
 @pytest.fixture
 def grid_shape(request):
+    if hasattr(request, "param"):  # indirect parametrization wins
+        return tuple(request.param)
     return _parse(request.config.getoption("--grid_shape"), (16, 16, 16))
 
 
 @pytest.fixture
 def proc_shape(request):
+    if hasattr(request, "param"):  # indirect parametrization wins
+        return tuple(request.param)
     return _parse(request.config.getoption("--proc_shape"), (2, 2, 1))
 
 

@@ -1,0 +1,94 @@
+"""DFT tests (analog of the reference's transform glue in
+/root/reference/pystella/fourier/dft.py and its usage tests)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+
+
+@pytest.fixture
+def decomp2d(proc_shape):
+    import jax
+    from pystella_tpu import DomainDecomposition
+    p = (proc_shape[0], proc_shape[1], 1)
+    n = int(np.prod(p))
+    return DomainDecomposition(p, devices=jax.devices()[:n])
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
+def test_r2c_roundtrip_matches_numpy(decomp2d, grid_shape, proc_shape):
+    fft = ps.DFT(decomp2d, grid_shape=grid_shape, dtype=np.float64)
+    rng = np.random.default_rng(1)
+    fx = rng.random(grid_shape)
+
+    fk = fft.dft(decomp2d.shard(fx))
+    assert fk.shape == grid_shape[:-1] + (grid_shape[-1] // 2 + 1,)
+    assert np.allclose(np.asarray(fk), np.fft.rfftn(fx), atol=1e-10)
+
+    back = fft.idft(fk)
+    assert np.allclose(np.asarray(back), fx, atol=1e-12)
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_c2c_roundtrip(decomp2d, grid_shape, proc_shape):
+    fft = ps.DFT(decomp2d, grid_shape=grid_shape, dtype=np.complex128)
+    assert not fft.is_real
+    rng = np.random.default_rng(2)
+    fx = rng.random(grid_shape) + 1j * rng.random(grid_shape)
+
+    fk = fft.dft(decomp2d.shard(fx))
+    assert fk.shape == grid_shape
+    assert np.allclose(np.asarray(fk), np.fft.fftn(fx), atol=1e-10)
+    assert np.allclose(np.asarray(fft.idft(fk)), fx, atol=1e-12)
+
+
+def test_fftfreq_positive_nyquist():
+    freq = ps.fftfreq(8)
+    assert freq[4] == 4  # numpy returns -4
+    assert np.array_equal(freq[:4], [0, 1, 2, 3])
+    assert np.array_equal(freq[5:], [-3, -2, -1])
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1)], indirect=True)
+def test_zero_corner_modes(decomp2d, proc_shape):
+    grid_shape = (8, 8, 8)
+    fft = ps.DFT(decomp2d, grid_shape=grid_shape, dtype=np.float64)
+    rng = np.random.default_rng(3)
+    fk = rng.random((8, 8, 5)) + 1j * rng.random((8, 8, 5))
+
+    out = fft.zero_corner_modes(fk.copy())
+    for i in (0, 4):
+        for j in (0, 4):
+            for k in (0, 4):
+                assert out[i, j, k] == 0
+    assert out[1, 2, 3] == fk[1, 2, 3]
+
+    out = fft.zero_corner_modes(fk.copy(), only_imag=True)
+    assert out[0, 4, 0] == fk[0, 4, 0].real
+    assert out[1, 2, 3] == fk[1, 2, 3]
+
+
+def test_z_decomposition_rejected():
+    import jax
+    decomp = ps.DomainDecomposition((1, 1, 2), devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="undecomposed z"):
+        ps.DFT(decomp, grid_shape=(8, 8, 8), dtype=np.float64)
+
+
+def test_make_hermitian_enforces_symmetry():
+    rng = np.random.default_rng(4)
+    fk = rng.random((8, 8, 5)) + 1j * rng.random((8, 8, 5))
+    fk = ps.make_hermitian(fk)
+
+    # on the kz=0 and kz=Nyquist planes, fk[-i,-j] == conj(fk[i,j])
+    for k in (0, 4):
+        for i in range(8):
+            for j in range(8):
+                assert np.isclose(fk[(-i) % 8, (-j) % 8, k],
+                                  np.conj(fk[i, j, k]))
+    # corners real
+    for i in (0, 4):
+        for j in (0, 4):
+            for k in (0, 4):
+                assert fk[i, j, k].imag == 0

@@ -1,0 +1,154 @@
+"""Projector identity tests (analog of
+/root/reference/test/test_projectors.py:40-437: transversality, TT-ness,
+polarization round-trips)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+from pystella_tpu.fourier import tensor_index as tid
+
+
+@pytest.fixture
+def setup(proc_shape, grid_shape):
+    import jax
+    p = (proc_shape[0], proc_shape[1], 1)
+    n = int(np.prod(p))
+    decomp = ps.DomainDecomposition(p, devices=jax.devices()[:n])
+    lattice = ps.Lattice(grid_shape, (3.0, 4.0, 5.0), dtype=np.float64)
+    fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
+    return decomp, lattice, fft
+
+
+def random_vector_k(fft, seed=5):
+    rng = np.random.default_rng(seed)
+    shape = (3,) + fft.shape(True)
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape))
+
+
+def eff_k_grids(proj):
+    eff = list(proj.eff_mom.values())
+    return np.meshgrid(*eff, indexing="ij", sparse=True)
+
+
+@pytest.mark.parametrize("h", [0, 1, 2])
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
+def test_transversify(setup, h, proc_shape):
+    decomp, lattice, fft = setup
+    proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
+
+    vec = decomp.shard(random_vector_k(fft))
+    vec_t = np.asarray(proj.transversify(vec))
+
+    kx, ky, kz = eff_k_grids(proj)
+    div = kx * vec_t[0] + ky * vec_t[1] + kz * vec_t[2]
+    scale = np.abs(np.asarray(vec)).max()
+    assert np.abs(div).max() / scale < 1e-12
+
+    # idempotent
+    vec_t2 = np.asarray(proj.transversify(decomp.shard(vec_t)))
+    assert np.allclose(vec_t2, vec_t, atol=1e-12)
+
+
+@pytest.mark.parametrize("h", [0, 2])
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
+def test_vec_pol_roundtrip(setup, h, proc_shape):
+    decomp, lattice, fft = setup
+    proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
+
+    vec = decomp.shard(random_vector_k(fft))
+    plus, minus = proj.vec_to_pol(vec)
+    back = proj.pol_to_vec(plus, minus)
+
+    # pol_to_vec(vec_to_pol(v)) equals the transverse part of v
+    vec_t = np.asarray(proj.transversify(vec))
+    assert np.allclose(np.asarray(back), vec_t, atol=1e-11)
+
+    # and projecting again to polarizations is the identity
+    plus2, minus2 = proj.vec_to_pol(back)
+    assert np.allclose(np.asarray(plus2), np.asarray(plus), atol=1e-11)
+    assert np.allclose(np.asarray(minus2), np.asarray(minus), atol=1e-11)
+
+
+@pytest.mark.parametrize("h", [0, 2])
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_vector_decomposition_roundtrip(setup, h, proc_shape):
+    decomp, lattice, fft = setup
+    proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
+
+    vec_host = random_vector_k(fft)
+    vec = decomp.shard(vec_host)
+
+    # the times_abs_k flag states whether lng carries an extra |k| factor,
+    # so a decompose/rebuild roundtrip uses *opposite* flags (reference
+    # projectors.py:166-189)
+    for times_abs_k in (False, True):
+        plus, minus, lng = proj.decompose_vector(vec,
+                                                 times_abs_k=times_abs_k)
+        back = proj.decomp_to_vec(plus, minus, lng,
+                                  times_abs_k=not times_abs_k)
+
+        # roundtrip recovers v wherever all stencil momenta are defined
+        kx, ky, kz = eff_k_grids(proj)
+        mask = np.broadcast_to(
+            (kx**2 + ky**2 + kz**2) > 1e-20, vec_host[0].shape)
+        diff = np.abs(np.asarray(back) - vec_host)[:, mask]
+        assert diff.max() < 1e-11, f"times_abs_k={times_abs_k}"
+
+
+@pytest.mark.parametrize("h", [0, 1, 2])
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
+def test_transverse_traceless(setup, h, proc_shape):
+    decomp, lattice, fft = setup
+    proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
+
+    rng = np.random.default_rng(7)
+    shape = (6,) + fft.shape(True)
+    hij = rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+    hij_tt = np.asarray(proj.transverse_traceless(decomp.shard(hij)))
+
+    scale = np.abs(hij).max()
+    kx, ky, kz = eff_k_grids(proj)
+    kvec = [kx, ky, kz]
+
+    # traceless
+    trace = sum(hij_tt[tid(a, a)] for a in range(1, 4))
+    assert np.abs(trace).max() / scale < 1e-12
+
+    # transverse: k_a h_ab = 0 for each b
+    for b in range(1, 4):
+        div = sum(kvec[a - 1] * hij_tt[tid(a, b)] for a in range(1, 4))
+        assert np.abs(div).max() / scale < 1e-11
+
+    # idempotent
+    hij_tt2 = np.asarray(proj.transverse_traceless(decomp.shard(hij_tt)))
+    assert np.allclose(hij_tt2, hij_tt, atol=1e-11)
+
+
+@pytest.mark.parametrize("h", [0, 2])
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_tensor_pol_roundtrip(setup, h, proc_shape):
+    decomp, lattice, fft = setup
+    proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
+
+    rng = np.random.default_rng(8)
+    kshape = fft.shape(True)
+    plus = decomp.shard(rng.standard_normal(kshape)
+                        + 1j * rng.standard_normal(kshape))
+    minus = decomp.shard(rng.standard_normal(kshape)
+                         + 1j * rng.standard_normal(kshape))
+
+    hij = proj.pol_to_tensor(plus, minus)
+    plus2, minus2 = proj.tensor_to_pol(hij)
+
+    # roundtrip away from zeroed momenta
+    kx, ky, kz = eff_k_grids(proj)
+    mask = np.broadcast_to((kx**2 + ky**2 + kz**2) > 1e-20, kshape)
+    assert np.abs(np.asarray(plus2) - np.asarray(plus))[mask].max() < 1e-11
+    assert np.abs(np.asarray(minus2) - np.asarray(minus))[mask].max() < 1e-11
+
+    # the constructed tensor is automatically TT
+    hij_tt = np.asarray(proj.transverse_traceless(hij))
+    diff = np.abs(hij_tt - np.asarray(hij))[:, mask]
+    assert diff.max() < 1e-11

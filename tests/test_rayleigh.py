@@ -1,0 +1,112 @@
+"""Gaussian random field generation tests (analog of
+/root/reference/test/test_rayleigh.py:64-111: recovered power law +
+Gaussianity)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+
+
+@pytest.fixture
+def setup(proc_shape):
+    import jax
+    p = (proc_shape[0], proc_shape[1], 1)
+    n = int(np.prod(p))
+    decomp = ps.DomainDecomposition(p, devices=jax.devices()[:n])
+    grid_shape = (32, 32, 32)
+    lattice = ps.Lattice(grid_shape, (10.0, 10.0, 10.0), dtype=np.float64)
+    fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
+    return decomp, lattice, fft
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
+@pytest.mark.parametrize("alpha", [-3.0, -1.0])
+def test_power_law_recovered(setup, proc_shape, alpha):
+    decomp, lattice, fft = setup
+    rayleigh = ps.RayleighGenerator(fft=fft, dk=lattice.dk,
+                                    volume=lattice.volume, seed=42)
+    spectra = ps.PowerSpectra(decomp, fft, lattice.dk, lattice.volume)
+
+    fx = rayleigh.init_field(field_ps=lambda k: k**alpha, random=False)
+    result = spectra(fx, k_power=3)
+
+    # expected dimensionless spectrum: k^3 * ps(k) / (2 pi^2)
+    kbins = np.arange(spectra.num_bins) * spectra.bin_width
+    mid = slice(3, spectra.num_bins // 2)  # well-sampled shells
+    expected = kbins[mid]**3 * kbins[mid]**alpha / (2 * np.pi**2)
+    rel = np.abs(result[mid] - expected) / expected
+    # deterministic amplitudes: deviations only from shell-binning
+    # discreteness (reference tolerates 10-30%, test_rayleigh.py:64-111)
+    assert np.max(rel) < 0.1, f"max rel deviation {np.max(rel)}"
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_gaussianity(setup, proc_shape):
+    decomp, lattice, fft = setup
+    rayleigh = ps.RayleighGenerator(fft=fft, dk=lattice.dk,
+                                    volume=lattice.volume, seed=7)
+
+    fx = np.asarray(rayleigh.init_field(field_ps=lambda k: k**-3))
+    std = fx.std()
+    skew = np.mean((fx - fx.mean())**3) / std**3
+    kurt = np.mean((fx - fx.mean())**4) / std**4
+    assert abs(skew) < 0.05
+    assert abs(kurt - 3) < 0.15
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_field_is_real_and_seeded(setup, proc_shape):
+    decomp, lattice, fft = setup
+    r1 = ps.RayleighGenerator(fft=fft, dk=lattice.dk,
+                              volume=lattice.volume, seed=3)
+    r2 = ps.RayleighGenerator(fft=fft, dk=lattice.dk,
+                              volume=lattice.volume, seed=3)
+    f1 = np.asarray(r1.init_field())
+    f2 = np.asarray(r2.init_field())
+    assert np.array_equal(f1, f2)
+    assert f1.dtype == np.float64
+    assert np.all(np.isfinite(f1))
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_wkb_init(setup, proc_shape):
+    decomp, lattice, fft = setup
+    rayleigh = ps.RayleighGenerator(fft=fft, dk=lattice.dk,
+                                    volume=lattice.volume, seed=11)
+    spectra = ps.PowerSpectra(decomp, fft, lattice.dk, lattice.volume)
+
+    # massless WKB: ps = 1/(2 omega); check both f and df spectra
+    fx, dfx = rayleigh.init_WKB_fields(random=False, hubble=0.0)
+    spec_f = spectra(fx, k_power=3)
+    spec_df = spectra(dfx, k_power=3)
+
+    kbins = np.arange(spectra.num_bins) * spectra.bin_width
+    mid = slice(3, spectra.num_bins // 2)
+    # <|f_k|^2> = 1/(2 omega) = 1/(2k); <|df_k|^2> = omega^2 <|f_k|^2> = k/2
+    expected_f = kbins[mid]**3 / (2 * kbins[mid]) / (2 * np.pi**2)
+    expected_df = kbins[mid]**3 * kbins[mid] / 2 / (2 * np.pi**2)
+    assert np.max(np.abs(spec_f[mid] - expected_f) / expected_f) < 0.12
+    # df modes keep phase randomness even with random=False (|L - R| varies),
+    # so the df check is statistical: per-shell within 50%, mean ratio tight
+    rel_df = spec_df[mid] / expected_df
+    assert np.max(np.abs(rel_df - 1)) < 0.5
+    assert abs(np.mean(rel_df) - 1) < 0.1
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_transverse_vector_init(setup, proc_shape):
+    decomp, lattice, fft = setup
+    rayleigh = ps.RayleighGenerator(fft=fft, dk=lattice.dk,
+                                    volume=lattice.volume, seed=5)
+    proj = ps.Projector(fft, 0, lattice.dk, lattice.dx)
+
+    vec = rayleigh.init_transverse_vector(proj)
+    assert vec.shape == (3,) + fft.grid_shape
+
+    # transversality in k-space
+    vec_k = np.asarray(fft.dft(vec))
+    eff = list(proj.eff_mom.values())
+    kx, ky, kz = np.meshgrid(*eff, indexing="ij", sparse=True)
+    div = kx * vec_k[0] + ky * vec_k[1] + kz * vec_k[2]
+    assert np.abs(div).max() / np.abs(vec_k).max() < 1e-10
