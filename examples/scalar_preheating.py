@@ -1,0 +1,245 @@
+"""Scalar-field preheating after inflation, with optional gravitational-wave
+production — the flagship application.
+
+TPU-native analog of /root/reference/examples/scalar_preheating.py:28-283:
+two (or more) coupled scalars in conformal FLRW spacetime with WKB
+vacuum-fluctuation initial conditions, self-consistent scale-factor
+evolution via the Friedmann equations, energy reductions, power spectra,
+histograms, and provenance-rich HDF5 output — over a sharded device mesh.
+"""
+
+from argparse import ArgumentParser
+
+import numpy as np
+
+import pystella_tpu as ps
+
+parser = ArgumentParser()
+parser.add_argument("--grid-shape", "-grid", type=int, nargs=3,
+                    metavar=("Nx", "Ny", "Nz"), default=(128, 128, 128))
+parser.add_argument("--proc-shape", "-proc", type=int, nargs=3,
+                    metavar=("Npx", "Npy", "Npz"), default=(1, 1, 1))
+parser.add_argument("--dtype", type=np.dtype, default=np.float64)
+parser.add_argument("--halo-shape", type=int, default=2, metavar="h",
+                    help="stencil radius; 0 selects spectral derivatives")
+parser.add_argument("--box-dim", "-box", type=float, nargs=3,
+                    metavar=("Lx", "Ly", "Lz"), default=(5., 5., 5.))
+parser.add_argument("--kappa", type=float, default=1 / 10,
+                    help="timestep to grid-spacing ratio")
+parser.add_argument("--mpl", type=float, default=1.)
+parser.add_argument("--mphi", type=float, default=1.20e-6)
+parser.add_argument("--mchi", type=float, default=0.)
+parser.add_argument("--gsq", type=float, default=2.5e-7)
+parser.add_argument("--sigma", type=float, default=0.)
+parser.add_argument("--lambda4", type=float, default=0.)
+parser.add_argument("--end-time", "-end-t", type=float, default=20)
+parser.add_argument("--end-scale-factor", "-end-a", type=float, default=20)
+parser.add_argument("--gravitational-waves", "-gws", action="store_true")
+parser.add_argument("--outfile", type=str, default=None)
+parser.add_argument("--seed", type=int, default=49279)
+
+
+def main(argv=None):
+    import jax
+    p = parser.parse_args(argv)
+    p.grid_shape = tuple(p.grid_shape)
+    p.proc_shape = tuple(p.proc_shape)
+    p.box_dim = tuple(p.box_dim)
+    p.grid_size = float(np.prod(p.grid_shape))
+
+    lattice = ps.Lattice(p.grid_shape, p.box_dim, dtype=p.dtype)
+    dt = p.kappa * min(lattice.dx)
+
+    p.nscalars = 2
+    f0 = [.193 * p.mpl, 0]
+    df0 = [-.142231 * p.mpl, 0]
+    Stepper = ps.LowStorageRK54
+
+    ndev = int(np.prod(p.proc_shape))
+    decomp = ps.DomainDecomposition(p.proc_shape,
+                                    devices=jax.devices()[:ndev])
+    fft = ps.DFT(decomp, grid_shape=p.grid_shape, dtype=p.dtype)
+    if p.halo_shape == 0:
+        derivs = ps.SpectralCollocator(fft, lattice.dk)
+    else:
+        derivs = ps.FiniteDifferencer(decomp, p.halo_shape, lattice.dx)
+
+    def potential(f):
+        phi, chi = f[0], f[1]
+        unscaled = (p.mphi**2 / 2 * phi**2
+                    + p.mchi**2 / 2 * chi**2
+                    + p.gsq / 2 * phi**2 * chi**2
+                    + p.sigma / 2 * phi * chi**2
+                    + p.lambda4 / 4 * chi**4)
+        return unscaled / p.mphi**2
+
+    scalar_sector = ps.ScalarSector(p.nscalars, potential=potential)
+    sectors = [scalar_sector]
+    if p.gravitational_waves:
+        gw_sector = ps.TensorPerturbationSector([scalar_sector])
+        sectors.append(gw_sector)
+
+    merged = {}
+    for sector in sectors:
+        merged.update(sector.rhs_dict)
+    sector_rhs = ps.compile_rhs_dict(merged)
+
+    def full_rhs(state, t, a, hubble):
+        aux = {"lap_f": derivs.lap(state["f"]), "a": a, "hubble": hubble}
+        if p.gravitational_waves:
+            aux["dfdx"] = derivs.grad(state["f"])
+            aux["lap_hij"] = derivs.lap(state["hij"])
+        return sector_rhs(state, t, **aux)
+
+    stepper = Stepper(full_rhs, dt=dt)
+
+    reduce_energy = ps.Reduction(decomp, scalar_sector,
+                                 callback=ps.get_rho_and_p,
+                                 grid_size=p.grid_size)
+
+    def compute_energy(state, a):
+        return reduce_energy(f=state["f"], dfdt=state["dfdt"],
+                             lap_f=derivs.lap(state["f"]),
+                             a=np.float64(a))
+
+    # observables
+    out = ps.OutputFile(runfile=__file__, name=p.outfile) \
+        if decomp.rank == 0 else None
+    statistics = ps.FieldStatistics(decomp, grid_size=p.grid_size)
+    spectra = ps.PowerSpectra(decomp, fft, lattice.dk, lattice.volume)
+    projector = ps.Projector(fft, p.halo_shape, lattice.dk, lattice.dx)
+    hist = ps.FieldHistogrammer(decomp, 1000, p.dtype)
+
+    hubble_var = ps.Var("hubble")
+    a_sq_rho = 3 * p.mpl**2 * hubble_var**2 / 8 / np.pi
+    compute_rho = ps.ElementWiseMap(
+        {ps.Field("rho"): scalar_sector.stress_tensor(0, 0) / a_sq_rho})
+
+    def output(step_count, t, energy, expand, state):
+        if step_count % 4 == 0:
+            f_stats = statistics(state["f"])
+            if out is not None:
+                out.output(
+                    "energy", t=t, a=expand.a,
+                    adot=expand.adot / expand.a,
+                    hubble=expand.hubble / expand.a,
+                    **{k: np.asarray(v) for k, v in energy.items()},
+                    eos=energy["pressure"] / energy["total"],
+                    constraint=expand.constraint(energy["total"]))
+                out.output("statistics/f", t=t, a=expand.a, **f_stats)
+
+        if expand.a / output.a_last_spec >= 1.05:
+            output.a_last_spec = expand.a
+
+            dfdx = derivs.grad(state["f"])
+            rho = compute_rho(
+                a=np.float64(expand.a), hubble=np.float64(expand.hubble),
+                f=state["f"], dfdt=state["dfdt"], dfdx=dfdx)["rho"]
+            rho_hist = hist(rho)
+            spec_out = {"scalar": spectra(state["f"]), "rho": spectra(rho)}
+
+            if p.gravitational_waves:
+                spec_out["gw"] = spectra.gw(state["dhijdt"], projector,
+                                            expand.hubble)
+
+            if out is not None:
+                out.output("rho_histogram", t=t, a=expand.a, **rho_hist)
+                out.output("spectra", t=t, a=expand.a, **spec_out)
+
+    output.a_last_spec = .1
+
+    print("Initializing fields")
+    state = {
+        "f": decomp.shard(np.stack(
+            [np.full(p.grid_shape, f0[i], p.dtype)
+             for i in range(p.nscalars)])),
+        "dfdt": decomp.shard(np.stack(
+            [np.full(p.grid_shape, df0[i], p.dtype)
+             for i in range(p.nscalars)])),
+    }
+    if p.gravitational_waves:
+        state["hij"] = decomp.zeros(p.grid_shape, p.dtype, outer_shape=(6,))
+        state["dhijdt"] = decomp.zeros(p.grid_shape, p.dtype,
+                                       outer_shape=(6,))
+
+    # background energy -> initial expansion
+    energy = compute_energy(state, 1.)
+    expand = ps.Expansion(energy["total"], Stepper, mpl=p.mpl)
+
+    # effective masses (with Hubble correction) for WKB initialization,
+    # via symbolic second derivatives of the potential
+    addot = expand.addot_friedmann_2(expand.a, energy["total"],
+                                     energy["pressure"])
+    hubble_correction = - addot / expand.a
+    fsym = ps.Field("f0_bg", shape=(p.nscalars,))
+    eff_mass = [
+        float(ps.evaluate(ps.diff(potential(fsym), fsym[i], fsym[i]),
+                          {"f0_bg": np.array(f0)})) + hubble_correction
+        for i in range(p.nscalars)]
+
+    modes = ps.RayleighGenerator(fft=fft, dk=lattice.dk,
+                                 volume=lattice.volume, seed=p.seed)
+
+    fluct_f, fluct_df = [], []
+    for fld in range(p.nscalars):
+        fx, dfx = modes.init_WKB_fields(
+            norm=p.mphi**2,
+            omega_k=lambda k, fld=fld: np.sqrt(k**2 + eff_mass[fld]),
+            hubble=expand.hubble)
+        fluct_f.append(np.asarray(fx))
+        fluct_df.append(np.asarray(dfx))
+
+    state["f"] = state["f"] + decomp.shard(np.stack(fluct_f))
+    state["dfdt"] = state["dfdt"] + decomp.shard(np.stack(fluct_df))
+
+    # re-initialize energy and expansion with fluctuations included
+    energy = compute_energy(state, expand.a)
+    expand = ps.Expansion(energy["total"], Stepper, mpl=p.mpl)
+
+    t, step_count = 0., 0
+    output(step_count, t, energy, expand, state)
+
+    if decomp.rank == 0:
+        print("Time evolution beginning")
+        print("time\t", "scale factor", "ms/step\t", "steps/second",
+              sep="\t")
+
+    from time import time
+    start = time()
+    last_out = time()
+
+    carry = None
+    while t < p.end_time and expand.a < p.end_scale_factor:
+        for s in range(stepper.num_stages):
+            carry = stepper(s, state if s == 0 else carry, t,
+                            a=np.float64(expand.a),
+                            hubble=np.float64(expand.hubble))
+            expand.step(s, energy["total"], energy["pressure"], dt)
+            if s == stepper.num_stages - 1:
+                state = carry
+                energy = compute_energy(state, expand.a)
+            else:
+                current = carry[0] if isinstance(carry, tuple) else carry[1]
+                energy = compute_energy(current, expand.a)
+
+        t += dt
+        step_count += 1
+        output(step_count, t, energy, expand, state)
+        if time() - last_out > 30 and decomp.rank == 0:
+            last_out = time()
+            ms_per_step = (last_out - start) * 1e3 / step_count
+            print(f"{t:<15.3f}", f"{expand.a:<15.3f}",
+                  f"{ms_per_step:<15.3f}", f"{1e3 / ms_per_step:<15.3f}")
+
+    constraint = expand.constraint(energy["total"])
+    if decomp.rank == 0:
+        print("Simulation complete")
+        print(f"final constraint: {constraint:.16e}")
+        if out is not None:
+            out.file.attrs["final_constraint"] = constraint
+            out.close()
+    return constraint
+
+
+if __name__ == "__main__":
+    main()

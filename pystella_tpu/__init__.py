@@ -33,8 +33,13 @@ from pystella_tpu.fourier import (
     Projector, PowerSpectra, RayleighGenerator,
     SpectralCollocator, SpectralPoissonSolver,
 )
+from pystella_tpu.models import (
+    Sector, ScalarSector, TensorPerturbationSector, tensor_index,
+    get_rho_and_p, Expansion,
+)
+from pystella_tpu.utils import OutputFile, timer
 from pystella_tpu.step import (
-    Stepper, RungeKuttaStepper, LowStorageRKStepper,
+    Stepper, RungeKuttaStepper, LowStorageRKStepper, compile_rhs_dict,
     RungeKutta4, RungeKutta3Heun, RungeKutta3Nystrom, RungeKutta3Ralston,
     RungeKutta3SSP, RungeKutta2Midpoint, RungeKutta2Heun, RungeKutta2Ralston,
     LowStorageRK54, LowStorageRK144, LowStorageRK134, LowStorageRK124,
@@ -82,7 +87,9 @@ __all__ = [
     "DFT", "fftfreq", "pfftfreq", "make_hermitian",
     "Projector", "PowerSpectra", "RayleighGenerator",
     "SpectralCollocator", "SpectralPoissonSolver",
-    "Stepper", "RungeKuttaStepper", "LowStorageRKStepper",
+    "Sector", "ScalarSector", "TensorPerturbationSector", "tensor_index",
+    "get_rho_and_p", "Expansion", "OutputFile", "timer",
+    "Stepper", "RungeKuttaStepper", "LowStorageRKStepper", "compile_rhs_dict",
     "RungeKutta4", "RungeKutta3Heun", "RungeKutta3Nystrom",
     "RungeKutta3Ralston", "RungeKutta3SSP", "RungeKutta2Midpoint",
     "RungeKutta2Heun", "RungeKutta2Ralston",

@@ -19,14 +19,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pystella_tpu.models.sectors import tensor_index
+
 __all__ = ["Projector", "tensor_index"]
-
-
-def tensor_index(i, j):
-    """Symmetric rank-2 index packing to length-6 (1-indexed; reference
-    sectors.py:164-167)."""
-    a, b = min(i, j), max(i, j)
-    return (7 - a) * a // 2 - 4 + b
 
 
 class Projector:

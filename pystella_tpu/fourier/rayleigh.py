@@ -128,6 +128,8 @@ class RayleighGenerator:
                              minus_ps=None, queue=None, **kwargs):
         """Initialize a transverse vector from polarization spectra
         (reference rayleigh.py:280-323)."""
+        if plus_ps is None or minus_ps is None:
+            raise ValueError("plus_ps and minus_ps are required")
         plus_k = self.fft.decomp.shard(
             self.generate(field_ps=plus_ps, **kwargs))
         minus_k = self.fft.decomp.shard(

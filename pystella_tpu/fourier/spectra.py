@@ -66,9 +66,8 @@ class PowerSpectra:
         self.bin_counts = np.histogram(kmags, weights=counts, bins=bins)[0]
 
         # device-side bin indices and count weights, sharded like k-space
-        spec = decomp.spec(0)
         from jax.sharding import NamedSharding
-        sharding = NamedSharding(decomp.mesh, spec)
+        sharding = NamedSharding(decomp.mesh, decomp.spec(0))
         bin_idx = np.round(kmags / self.bin_width).astype(np.int32)
         self._bin_idx = jax.device_put(bin_idx, sharding)
         self._counts = jax.device_put(
@@ -76,42 +75,30 @@ class PowerSpectra:
         self._kmags = jax.device_put(
             kmags.astype(self.rdtype), sharding)
 
-        num_bins = self.num_bins
+        def weights_impl(fk, k_power):
+            w = self._counts * self._kmags**k_power * jnp.abs(fk)**2
+            b = jnp.broadcast_to(self._bin_idx, w.shape)
+            return b, w
 
-        def local_hist(bins_, weights):
-            h = jnp.bincount(bins_.ravel(), weights=weights.ravel(),
-                             length=num_bins)
-            return decomp.psum(h)
-
-        from jax.sharding import PartitionSpec as P
-
-        def bin_power_impl(fk, k_power):
-            weight = (self._counts * self._kmags**k_power
-                      * jnp.abs(fk)**2)
-            hist = decomp.shard_map(
-                local_hist, (spec, spec), P())(self._bin_idx, weight)
-            return hist / self.bin_counts
-
-        self._bin_power = jax.jit(bin_power_impl)
+        self._weights = jax.jit(weights_impl)
 
     def bin_power(self, fk, queue=None, k_power=3, allocator=None):
         """Unnormalized binned power spectrum of a momentum-space field,
-        weighted by ``|k|**k_power`` (reference spectra.py:140-175)."""
+        weighted by ``|k|**k_power`` (reference spectra.py:140-175). Outer
+        axes batch through a single distributed bincount."""
+        from pystella_tpu.ops.histogram import weighted_bincount
         if isinstance(fk, np.ndarray):
             fk = self.decomp.shard(fk)
-        return np.asarray(self._bin_power(fk, k_power))
+        b, w = self._weights(fk, k_power)
+        hist = weighted_bincount(self.decomp, b, w, self.num_bins)
+        return np.asarray(hist) / self.bin_counts
 
     def __call__(self, fx, queue=None, k_power=3, allocator=None):
         """Power spectrum Δ²_f(k) of a position-space field; outer axes are
-        looped over (reference spectra.py:177-226)."""
-        outer_shape = fx.shape[:-3]
-        slices = list(product(*[range(n) for n in outer_shape]))
-
-        result = np.zeros(outer_shape + (self.num_bins,), self.rdtype)
-        for s in slices:
-            fk = self.fft.dft(fx[s])
-            result[s] = self.bin_power(fk, k_power=k_power)
-        return self.norm * result
+        batched through the transform and a single binning pass
+        (the reference loops host-side instead, spectra.py:177-226)."""
+        fk = self.fft.dft(fx)
+        return self.norm * self.bin_power(fk, k_power=k_power)
 
     def polarization(self, vector, projector, queue=None, k_power=3,
                      allocator=None):
@@ -155,8 +142,7 @@ class PowerSpectra:
         hij_k = self.fft.dft(hij)
         hij_tt = projector.transverse_traceless(hij_k)
 
-        gw_spec = [self.bin_power(hij_tt[mu], k_power=k_power)
-                   for mu in range(6)]
+        gw_spec = self.bin_power(hij_tt, k_power=k_power)  # (6, num_bins)
         gw_tot = sum(gw_spec[tensor_index(i, j)]
                      for i in range(1, 4) for j in range(1, 4))
         return self.norm / 12 / hubble**2 * gw_tot

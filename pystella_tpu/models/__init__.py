@@ -1,0 +1,10 @@
+from pystella_tpu.models.sectors import (
+    Sector, ScalarSector, TensorPerturbationSector, tensor_index,
+    get_rho_and_p,
+)
+from pystella_tpu.models.expansion import Expansion
+
+__all__ = [
+    "Sector", "ScalarSector", "TensorPerturbationSector", "tensor_index",
+    "get_rho_and_p", "Expansion",
+]

@@ -19,10 +19,44 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from functools import lru_cache
+
 from pystella_tpu import field as _field
 from pystella_tpu.ops.reduction import Reduction
 
-__all__ = ["Histogrammer", "FieldHistogrammer"]
+__all__ = ["Histogrammer", "FieldHistogrammer", "weighted_bincount"]
+
+
+@lru_cache(maxsize=None)
+def _bincount_fn(decomp, outer_shape, num_bins):
+    """Build (and cache) the jitted distributed weighted-bincount for a
+    given decomposition / outer shape / bin count."""
+    from jax.sharding import PartitionSpec as P
+    nouter = int(np.prod(outer_shape, dtype=np.int64)) if outer_shape else 1
+    spec = decomp.spec(len(outer_shape))
+    out_spec = P(*(None,) * (len(outer_shape) + 1))
+
+    def local(b, w):
+        if nouter > 1:
+            # offset bins per outer slice: one bincount covers all slices
+            offsets = jnp.arange(nouter, dtype=jnp.int32).reshape(
+                outer_shape + (1, 1, 1))
+            b = b + offsets * num_bins
+        h = jnp.bincount(b.reshape(-1), weights=w.reshape(-1),
+                         length=num_bins * nouter)
+        return decomp.psum(h).reshape(outer_shape + (num_bins,))
+
+    return jax.jit(decomp.shard_map(local, (spec, spec), out_spec))
+
+
+def weighted_bincount(decomp, bins, weights, num_bins):
+    """Distributed weighted histogram: per-device ``jnp.bincount`` over the
+    local shard + ``psum`` over the mesh. ``bins`` (int32) and ``weights``
+    share shape ``outer + lattice``; returns ``outer + (num_bins,)``,
+    replicated. The shared primitive behind :class:`Histogrammer` and
+    :class:`~pystella_tpu.PowerSpectra`."""
+    outer_shape = tuple(bins.shape[:-3])
+    return _bincount_fn(decomp, outer_shape, int(num_bins))(bins, weights)
 
 
 class Histogrammer:
@@ -45,14 +79,7 @@ class Histogrammer:
 
         num_bins_ = self.num_bins
 
-        def local_hist(bins, weights):
-            h = jnp.bincount(bins.ravel(), weights=weights.ravel(),
-                             length=num_bins_)
-            return decomp.psum(h)
-
-        self._local_hist = local_hist
-
-        def run(env):
+        def prepare(env):
             out = {}
             for name, (bin_expr, weight_expr) in self.histograms.items():
                 b = _field.evaluate(bin_expr, env)
@@ -62,19 +89,17 @@ class Histogrammer:
                 acc_dtype = jnp.zeros((), self.dtype).dtype
                 b = jnp.clip(jnp.floor(b), 0, num_bins_ - 1).astype(jnp.int32)
                 w = jnp.broadcast_to(w, b.shape).astype(acc_dtype)
-                spec = self.decomp.spec(b.ndim - 3)
-                hist = self.decomp.shard_map(
-                    local_hist, (spec, spec),
-                    jax.sharding.PartitionSpec())(b, w)
-                out[name] = hist
+                out[name] = (b, w)
             return out
 
-        self._run = jax.jit(run)
+        self._prepare = jax.jit(prepare)
 
     def __call__(self, allocator=None, **env):
-        result = self._run(env)
-        return {k: np.asarray(v).astype(self.dtype)
-                for k, v in result.items()}
+        prepared = self._prepare(env)
+        return {name: np.asarray(
+                    weighted_bincount(self.decomp, b, w, self.num_bins)
+                ).astype(self.dtype)
+                for name, (b, w) in prepared.items()}
 
 
 class FieldHistogrammer(Histogrammer):

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from pystella_tpu import field as _field
 
 __all__ = [
-    "Stepper", "RungeKuttaStepper", "LowStorageRKStepper",
+    "Stepper", "RungeKuttaStepper", "LowStorageRKStepper", "compile_rhs_dict",
     "RungeKutta4", "RungeKutta3Heun", "RungeKutta3Nystrom",
     "RungeKutta3Ralston", "RungeKutta3SSP", "RungeKutta2Midpoint",
     "RungeKutta2Heun", "RungeKutta2Ralston",
@@ -53,12 +53,39 @@ def compile_rhs_dict(rhs_dict):
     """Compile a symbolic ``{Field: expr}`` dict (the reference's
     ``rhs_dict`` input to ``Stepper``, step.py:128-141) into a function
     ``rhs(state, t, **args) -> dstate``. Non-state names in the expressions
-    (laplacians, scale factor, ...) are looked up in ``args``."""
-    items = [(_key_name(k), v) for k, v in rhs_dict.items()]
+    (laplacians, scale factor, ...) are looked up in ``args``.
+
+    Keys may be whole Fields or indexed components (``f[0]``, ``f[1]``, ...,
+    as Sectors produce); component results are stacked along the leading
+    axis of the state entry."""
+    scalar_items = []
+    indexed = {}
+    for k, v in rhs_dict.items():
+        if isinstance(k, _field.Indexed):
+            if len(k.index) != 1:
+                raise ValueError(
+                    "only single-axis indexed rhs_dict keys are supported")
+            indexed.setdefault(k.field.name, {})[k.index[0]] = v
+        else:
+            scalar_items.append((_key_name(k), v))
+
+    for name, comps in indexed.items():
+        missing = set(range(len(comps))) - set(comps)
+        if missing:
+            raise ValueError(f"rhs_dict for {name} missing components "
+                             f"{sorted(missing)}")
 
     def rhs(state, t=0.0, **args):
         env = {**args, **state, "t": t}
-        return {name: _field.evaluate(expr, env) for name, expr in items}
+        out = {name: _field.evaluate(expr, env)
+               for name, expr in scalar_items}
+        for name, comps in indexed.items():
+            per_comp_shape = state[name].shape[1:]
+            out[name] = jnp.stack([
+                jnp.broadcast_to(_field.evaluate(comps[i], env),
+                                 per_comp_shape)
+                for i in range(len(comps))])
+        return out
 
     return rhs
 
@@ -281,7 +308,10 @@ class LowStorageRKStepper(Stepper):
     _C = []
 
     def init_carry(self, state):
-        k = jax.tree_util.tree_map(jnp.zeros_like, state)
+        # x * 0 (not jnp.zeros_like) keeps host scalars host-resident, so
+        # scalar ODE integration (Expansion) stays off-device like the
+        # reference's C-target stepper (expansion.py:95-99)
+        k = jax.tree_util.tree_map(lambda x: x * 0, state)
         return (state, k)
 
     def extract(self, carry):

@@ -1,0 +1,4 @@
+from pystella_tpu.utils.output import OutputFile
+from pystella_tpu.utils.profiling import timer
+
+__all__ = ["OutputFile", "timer"]

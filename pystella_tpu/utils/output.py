@@ -1,0 +1,106 @@
+"""Provenance-rich HDF5 run output.
+
+TPU-native counterpart of /root/reference/pystella/output.py:52-181: an
+append-only HDF5 time-series file recording run provenance (device info,
+hostname, the invoking script's own source, dependency versions) plus
+arbitrary appendable datasets created lazily on first output.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+
+import numpy as np
+
+__all__ = ["OutputFile"]
+
+
+class OutputFile:
+    """Appendable HDF5 output with run provenance.
+
+    :arg context: unused (API parity with the reference's pyopencl context
+        whose device info was recorded); device info comes from
+        ``jax.devices()`` instead.
+    :arg name: output filename stem; defaults to ``"output"`` with a
+        numeric suffix chosen to avoid collisions (reference output.py:92-96).
+    :arg runfile: path to the invoking script, whose text is stored
+        (defaults to ``sys.argv[0]``).
+
+    Any other keyword arguments are recorded as file attributes.
+    """
+
+    def __init__(self, context=None, name=None, runfile=None, **kwargs):
+        import h5py
+
+        if name is None:
+            i = 0
+            while os.path.exists(f"output-{i}.h5"):
+                i += 1
+            name = f"output-{i}"
+        self.filename = name if name.endswith(".h5") else name + ".h5"
+        self.file = h5py.File(self.filename, "a")
+
+        # run provenance (reference output.py:98-152)
+        try:
+            import jax
+            devices = jax.devices()
+            self.file.attrs["device"] = ", ".join(
+                str(d) for d in devices[:8])
+            self.file.attrs["platform"] = devices[0].platform
+            self.file.attrs["num_devices"] = len(devices)
+        except Exception:  # noqa: BLE001 — provenance is best-effort
+            pass
+        self.file.attrs["hostname"] = socket.gethostname()
+
+        for key, val in kwargs.items():
+            try:
+                self.file.attrs[key] = val
+            except TypeError:
+                self.file.attrs[key] = str(val)
+
+        runfile = runfile if runfile is not None else (
+            sys.argv[0] if sys.argv and os.path.exists(sys.argv[0]) else None)
+        if runfile:
+            try:
+                with open(runfile) as f:
+                    self.file.attrs["runfile"] = f.read()
+            except OSError:
+                pass
+
+        versions = {}
+        for mod in ("jax", "jaxlib", "numpy", "h5py"):
+            try:
+                versions[mod] = __import__(mod).__version__
+            except Exception:  # noqa: BLE001
+                pass
+        for mod, ver in versions.items():
+            self.file.attrs[f"{mod}_version"] = ver
+
+    def output(self, group, **kwargs):
+        """Append one record per keyword to (lazily-created) resizable
+        datasets under ``group`` (reference output.py:157-181)."""
+        if group not in self.file:
+            grp = self.file.create_group(group)
+        else:
+            grp = self.file[group]
+
+        for key, val in kwargs.items():
+            arr = np.asarray(val)
+            if key not in grp:
+                grp.create_dataset(key, shape=(0,) + arr.shape,
+                                   maxshape=(None,) + arr.shape,
+                                   dtype=arr.dtype)
+            dset = grp[key]
+            dset.resize(dset.shape[0] + 1, axis=0)
+            dset[-1] = arr
+
+    def close(self):
+        self.file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

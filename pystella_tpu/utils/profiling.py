@@ -1,0 +1,32 @@
+"""Benchmark/profiling helpers.
+
+The reference has no profiling subsystem; its mechanism is a warmup+average
+timing harness used by every test's ``__main__`` benchmark
+(/root/reference/test/common.py:41-56) plus per-kernel events. The analogs
+here: :func:`timer` (blocks on device completion via
+``jax.block_until_ready``), and ``jax.profiler`` for full TPU traces.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+__all__ = ["timer"]
+
+
+def timer(kernel, ntime=200, nwarmup=2, reps=1):
+    """Average milliseconds per call of ``kernel()`` (a thunk returning jax
+    arrays), with warmup; mirrors /root/reference/test/common.py:41-56."""
+    for _ in range(nwarmup):
+        result = kernel()
+    jax.block_until_ready(result)
+
+    start = time.perf_counter()
+    for _ in range(ntime):
+        for _ in range(reps):
+            result = kernel()
+    jax.block_until_ready(result)
+    elapsed = time.perf_counter() - start
+    return elapsed / ntime / reps * 1000

@@ -8,12 +8,8 @@ import pystella_tpu as ps
 
 
 @pytest.fixture
-def decomp2d(proc_shape):
-    import jax
-    from pystella_tpu import DomainDecomposition
-    p = (proc_shape[0], proc_shape[1], 1)
-    n = int(np.prod(p))
-    return DomainDecomposition(p, devices=jax.devices()[:n])
+def decomp2d(proc_shape, make_decomp):
+    return make_decomp((proc_shape[0], proc_shape[1], 1))
 
 
 @pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)

@@ -1,0 +1,70 @@
+"""End-to-end example regressions (analog of
+/root/reference/test/test_examples.py:31-67): run the example drivers as
+subprocesses and check physical invariants / golden values."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: this framework's golden Friedmann-constraint value for the 32³
+#: scalar-preheating run to t=1 (seed 49279). The reference's golden value
+#: for the same configuration is 5.5725530301309334e-08
+#: (/root/reference/test/test_examples.py:33) — the ~0.7% difference is the
+#: RNG realization of the WKB fluctuations; the deterministic background
+#: integration error dominates both.
+GOLDEN_CONSTRAINT = 5.5351373151601990e-08
+
+
+def run_example(script, *args):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert result.returncode == 0, result.stderr[-2000:]
+    return result.stdout
+
+
+def test_wave_equation():
+    stdout = run_example("wave_equation.py", "-grid", "32", "32", "32",
+                         "--end-time", "1")
+    drift = float(stdout.strip().splitlines()[-1].split()[2])
+    assert drift < 1e-3
+
+
+@pytest.mark.parametrize("proc", [(1, 1, 1), (2, 2, 1)])
+def test_scalar_preheating_golden(proc, tmp_path):
+    stdout = run_example(
+        "scalar_preheating.py", "-grid", "32", "32", "32", "-end-t", "1",
+        "-proc", *map(str, proc),
+        "--outfile", str(tmp_path / "out"))
+    line = [ln for ln in stdout.splitlines() if "final constraint" in ln][-1]
+    constraint = float(line.split()[-1])
+    assert abs(constraint - GOLDEN_CONSTRAINT) / GOLDEN_CONSTRAINT < 1e-3, \
+        f"constraint {constraint} vs golden {GOLDEN_CONSTRAINT}"
+
+    # output file written with expected structure
+    import h5py
+    with h5py.File(tmp_path / "out.h5", "r") as f:
+        assert "energy" in f and "statistics/f" in f and "spectra" in f
+        assert f["energy/constraint"].shape[0] > 0
+        assert "hostname" in f.attrs and "runfile" in f.attrs
+
+
+def test_scalar_preheating_gws(tmp_path):
+    stdout = run_example(
+        "scalar_preheating.py", "-grid", "16", "16", "16", "-end-t", "0.3",
+        "-gws", "--outfile", str(tmp_path / "gw"))
+    assert "Simulation complete" in stdout
+    import h5py
+    with h5py.File(tmp_path / "gw.h5", "r") as f:
+        assert "spectra" in f and "gw" in f["spectra"]

@@ -1,0 +1,72 @@
+"""Expansion tests: convergence against the exact matter-dominated FLRW
+solution (analog of /root/reference/test/test_expansion.py:36)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+
+
+W = 0.2  # equation of state; w=0 (matter) and w=1/3 (radiation) make the
+#          conformal-time ODE exactly polynomial, so use a generic w
+
+
+def exact_a(rho0, tau, w=W):
+    """Single-fluid FLRW in conformal time: a = (1 + B tau)^(2/(1+3w)) with
+    B fixed by Friedmann 1 at tau=0."""
+    b = (1 + 3 * w) / 2 * np.sqrt(8 * np.pi * rho0 / 3)
+    return (1 + b * tau) ** (2 / (1 + 3 * w))
+
+
+@pytest.mark.parametrize("stepper_cls",
+                         [ps.LowStorageRK54, ps.RungeKutta4,
+                          ps.LowStorageRK3Williamson])
+def test_single_fluid_convergence(stepper_cls):
+    rho0 = 0.83
+    t_end = 1.0
+
+    errors, dts = [], []
+    for m in (10, 20, 40, 80):
+        dt = t_end / m
+        expand = ps.Expansion(rho0, stepper_cls)
+        for _ in range(m):
+            for s in range(expand.stepper.num_stages):
+                energy = rho0 / expand.a ** (3 * (1 + W))
+                expand.step(s, energy, W * energy, dt)
+        errors.append(abs(expand.a - exact_a(rho0, t_end)))
+        dts.append(dt)
+
+    assert errors[-1] < 1e-7, f"{stepper_cls.__name__}: err {errors[-1]}"
+    order = np.log(errors[-2] / errors[-1]) / np.log(dts[-2] / dts[-1])
+    # the per-stage energy refresh (rather than in-stage coupling) costs
+    # some formal order; require at least second order, as observed
+    assert order > 1.8, f"{stepper_cls.__name__}: order {order}"
+
+
+def test_constraint_small():
+    rho0 = 1.7
+    expand = ps.Expansion(rho0, ps.LowStorageRK54)
+    dt = 1e-3
+    for _ in range(200):
+        for s in range(expand.stepper.num_stages):
+            energy = rho0 / expand.a**3
+            pressure = 0.0
+            expand.step(s, energy, pressure, dt)
+    assert expand.constraint(rho0 / expand.a**3) < 1e-8
+
+
+def test_friedmann_relations():
+    expand = ps.Expansion(2.0, ps.LowStorageRK54, mpl=3.0)
+    a, e, pr = 1.4, 2.0, 0.5
+    adot = expand.adot_friedmann_1(a, e)
+    assert np.isclose(adot**2, 8 * np.pi * a**2 / 3 / 9 * e * a**2)
+    addot = expand.addot_friedmann_2(a, e, pr)
+    assert np.isclose(addot, 4 * np.pi * a**2 / 3 / 9 * (e - 3 * pr) * a)
+
+
+def test_host_resident():
+    """Expansion state must stay host-side (no device arrays)."""
+    expand = ps.Expansion(1.0, ps.LowStorageRK54)
+    expand.step(0, 1.0, 0.0, 0.01)
+    assert isinstance(expand.a, (float, np.floating))
+    assert isinstance(expand.adot, (float, np.floating))

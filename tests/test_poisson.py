@@ -9,11 +9,8 @@ import pystella_tpu as ps
 
 
 @pytest.fixture
-def setup(proc_shape, grid_shape):
-    import jax
-    p = (proc_shape[0], proc_shape[1], 1)
-    n = int(np.prod(p))
-    decomp = ps.DomainDecomposition(p, devices=jax.devices()[:n])
+def setup(proc_shape, grid_shape, make_decomp):
+    decomp = make_decomp((proc_shape[0], proc_shape[1], 1))
     lattice = ps.Lattice(grid_shape, (7.0, 8.0, 9.0), dtype=np.float64)
     fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
     return decomp, lattice, fft

@@ -10,11 +10,8 @@ from pystella_tpu.fourier import tensor_index as tid
 
 
 @pytest.fixture
-def setup(proc_shape, grid_shape):
-    import jax
-    p = (proc_shape[0], proc_shape[1], 1)
-    n = int(np.prod(p))
-    decomp = ps.DomainDecomposition(p, devices=jax.devices()[:n])
+def setup(proc_shape, grid_shape, make_decomp):
+    decomp = make_decomp((proc_shape[0], proc_shape[1], 1))
     lattice = ps.Lattice(grid_shape, (3.0, 4.0, 5.0), dtype=np.float64)
     fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
     return decomp, lattice, fft

@@ -1,0 +1,129 @@
+"""Sector tests: Klein-Gordon right-hand sides, energy reducers, stress
+tensors (analog of /root/reference/test/test_energy.py semantics checks)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+from pystella_tpu.field import evaluate
+
+
+@pytest.fixture
+def env(grid_shape):
+    rng = np.random.default_rng(31)
+    n = 2
+    return {
+        "f": rng.standard_normal((n,) + grid_shape),
+        "dfdt": rng.standard_normal((n,) + grid_shape),
+        "lap_f": rng.standard_normal((n,) + grid_shape),
+        "dfdx": rng.standard_normal((n, 3) + grid_shape),
+        "a": 1.3,
+        "hubble": 0.7,
+    }
+
+
+def potential(f):
+    return 0.5 * f[0] ** 2 + 0.25 * f[1] ** 4 + 0.1 * f[0] ** 2 * f[1] ** 2
+
+
+def test_scalar_sector_rhs(env):
+    sector = ps.ScalarSector(2, potential=potential)
+    rhs = ps.compile_rhs_dict(sector.rhs_dict)
+
+    state = {"f": env["f"], "dfdt": env["dfdt"]}
+    out = rhs(state, 0.0, lap_f=env["lap_f"], a=env["a"],
+              hubble=env["hubble"])
+
+    assert np.allclose(np.asarray(out["f"]), env["dfdt"])
+
+    f0, f1 = env["f"]
+    dv0 = f0 + 0.2 * f0 * f1 ** 2
+    dv1 = f1 ** 3 + 0.2 * f0 ** 2 * f1
+    for i, dv in enumerate((dv0, dv1)):
+        expected = (env["lap_f"][i] - 2 * env["hubble"] * env["dfdt"][i]
+                    - env["a"] ** 2 * dv)
+        assert np.allclose(np.asarray(out["dfdt"][i]), expected), i
+
+
+def test_scalar_sector_reducers(env, decomp, grid_shape):
+    sector = ps.ScalarSector(2, potential=potential)
+    reducer = ps.Reduction(decomp, sector, callback=ps.get_rho_and_p)
+
+    result = reducer(f=decomp.shard(env["f"]),
+                     dfdt=decomp.shard(env["dfdt"]),
+                     lap_f=decomp.shard(env["lap_f"]), a=env["a"])
+
+    kin = np.mean(env["dfdt"] ** 2 / 2 / env["a"] ** 2, axis=(1, 2, 3))
+    grd = np.mean(-env["f"] * env["lap_f"] / 2 / env["a"] ** 2,
+                  axis=(1, 2, 3))
+    pot = np.mean(potential(env["f"]))
+
+    assert np.allclose(result["kinetic"], kin, rtol=1e-12)
+    assert np.allclose(result["gradient"], grd, rtol=1e-12)
+    assert np.isclose(np.sum(result["potential"]), pot, rtol=1e-12)
+    assert np.isclose(result["total"],
+                      kin.sum() + grd.sum() + pot, rtol=1e-12)
+    assert np.isclose(result["pressure"],
+                      kin.sum() - grd.sum() / 3 - pot, rtol=1e-12)
+
+
+def test_stress_tensor_t00(env):
+    """T_00 = sum_f (f')^2/2 + a^2 V + gradient terms (conformal FLRW)."""
+    sector = ps.ScalarSector(2, potential=potential)
+    t00 = evaluate(sector.stress_tensor(0, 0), env)
+
+    f, dfdt, dfdx, a = env["f"], env["dfdt"], env["dfdx"], env["a"]
+    kinetic = np.sum(dfdt ** 2, axis=0)
+    grad_sq = np.sum(dfdx ** 2, axis=(0, 1))
+    lag = (np.sum(dfdt ** 2, axis=0) - grad_sq) / (2 * a ** 2) \
+        - potential(f)
+    expected = kinetic - a ** 2 * lag
+    assert np.allclose(np.asarray(t00), expected, rtol=1e-12)
+
+
+def test_stress_tensor_off_diagonal(env):
+    sector = ps.ScalarSector(2, potential=potential)
+    t12 = evaluate(sector.stress_tensor(1, 2, drop_trace=True), env)
+    expected = np.sum(env["dfdx"][:, 0] * env["dfdx"][:, 1], axis=0)
+    assert np.allclose(np.asarray(t12), expected, rtol=1e-12)
+
+
+def test_tensor_perturbation_rhs(env, grid_shape):
+    scalar = ps.ScalarSector(2, potential=potential)
+    gw = ps.TensorPerturbationSector([scalar])
+    rhs = ps.compile_rhs_dict(gw.rhs_dict)
+
+    rng = np.random.default_rng(32)
+    state = {"hij": rng.standard_normal((6,) + grid_shape),
+             "dhijdt": rng.standard_normal((6,) + grid_shape)}
+    aux = {"lap_hij": rng.standard_normal((6,) + grid_shape),
+           "dfdx": env["dfdx"], "dfdt": env["dfdt"], "f": env["f"],
+           "a": env["a"], "hubble": env["hubble"]}
+    out = rhs(state, 0.0, **aux)
+
+    assert np.allclose(np.asarray(out["hij"]), state["dhijdt"])
+    # check the (1,2) component: S_12 = sum_f d1 f d2 f
+    idx = ps.tensor_index(1, 2)
+    s12 = np.sum(env["dfdx"][:, 0] * env["dfdx"][:, 1], axis=0)
+    expected = (aux["lap_hij"][idx]
+                - 2 * env["hubble"] * state["dhijdt"][idx]
+                + 16 * np.pi * s12)
+    assert np.allclose(np.asarray(out["dhijdt"][idx]), expected, rtol=1e-12)
+
+
+def test_tensor_index():
+    # 1-indexed sym-6 packing (reference sectors.py:164-167)
+    expected = {(1, 1): 0, (1, 2): 1, (1, 3): 2,
+                (2, 2): 3, (2, 3): 4, (3, 3): 5}
+    for (i, j), v in expected.items():
+        assert ps.tensor_index(i, j) == v
+        assert ps.tensor_index(j, i) == v
+
+
+def test_get_rho_and_p():
+    energy = {"kinetic": np.array([1.0, 2.0]),
+              "potential": np.array([0.5]),
+              "gradient": np.array([0.3, 0.6])}
+    out = ps.get_rho_and_p(energy)
+    assert np.isclose(out["total"], 4.4)
+    assert np.isclose(out["pressure"], 3.0 - 0.9 / 3 - 0.5)
