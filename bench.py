@@ -1,0 +1,115 @@
+"""Headline benchmark: scalar-preheating site-updates per second per chip.
+
+Measures the flagship hot loop — the fully fused LowStorageRK54 step of the
+two-field preheating system (Klein-Gordon right-hand sides + order-4
+finite-difference Laplacian with halo exchange), the same per-step work as
+/root/reference/examples/scalar_preheating.py:258-266 — and prints one JSON
+line ``{"metric", "value", "unit", "vs_baseline"}``. The baseline is the
+north-star target in BASELINE.json: 1e9 site-updates/s/chip at 512**3.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_step(grid_shape, dtype=np.float32, halo_shape=2):
+    import jax
+    import pystella_tpu as ps
+
+    lattice = ps.Lattice(grid_shape, (5.0, 5.0, 5.0), dtype=dtype)
+    dt = dtype(0.1 * min(lattice.dx))
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+    derivs = ps.FiniteDifferencer(decomp, halo_shape, lattice.dx)
+
+    mphi, gsq = 1.20e-6, 2.5e-7
+
+    def potential(f):
+        phi, chi = f[0], f[1]
+        return (mphi**2 / 2 * phi**2 + gsq / 2 * phi**2 * chi**2) / mphi**2
+
+    sector = ps.ScalarSector(2, potential=potential)
+    sector_rhs = ps.compile_rhs_dict(sector.rhs_dict)
+
+    def full_rhs(state, t, a, hubble):
+        return sector_rhs(state, t, lap_f=derivs.lap(state["f"]),
+                          a=a, hubble=hubble)
+
+    stepper = ps.LowStorageRK54(full_rhs, dt=dt)
+
+    def one_step(state, t, dt, a, hubble):
+        carry = stepper.init_carry(state)
+        for s in range(stepper.num_stages):
+            carry = stepper.stage(s, carry, t, dt,
+                                  {"a": a, "hubble": hubble})
+        return stepper.extract(carry)
+
+    step = jax.jit(one_step, donate_argnums=0)
+
+    rng = np.random.default_rng(7)
+    state = {
+        "f": decomp.shard(
+            0.1 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
+        "dfdt": decomp.shard(
+            0.01 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
+    }
+    return step, state, dt
+
+
+def run(grid_shape, nsteps=10, nwarmup=2, dtype=np.float32):
+    import jax
+
+    step, state, dt = build_step(grid_shape, dtype)
+    t, a, hubble = dtype(0.0), dtype(1.0), dtype(0.5)
+
+    import jax.numpy as jnp
+
+    # a scalar readback forces execution even on async remote-device
+    # transports where block_until_ready returns early
+    def sync(state):
+        return float(jnp.sum(state["f"][0, 0, 0, :8]))
+
+    for _ in range(nwarmup):
+        state = step(state, t, dt, a, hubble)
+    sync(state)
+
+    start = time.perf_counter()
+    for _ in range(nsteps):
+        state = step(state, t, dt, a, hubble)
+    sync(state)
+    elapsed = time.perf_counter() - start
+
+    sites = float(np.prod(grid_shape))
+    return sites * nsteps / elapsed, elapsed / nsteps
+
+
+def main():
+    grids = [(512, 512, 512), (256, 256, 256), (128, 128, 128)]
+    if "--grid" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--grid") + 1])
+        grids = [(n, n, n)]
+
+    for grid_shape in grids:
+        try:
+            updates_per_s, s_per_step = run(grid_shape)
+        except Exception as e:  # OOM on small chips: fall back
+            print(f"bench at {grid_shape} failed ({type(e).__name__}); "
+                  "falling back", file=sys.stderr)
+            continue
+        n = grid_shape[0]
+        print(f"{n}^3: {s_per_step * 1e3:.2f} ms/step, "
+              f"{updates_per_s:.3e} site-updates/s", file=sys.stderr)
+        print(json.dumps({
+            "metric": f"site-updates/sec/chip ({n}^3 preheating, RK54+lap4)",
+            "value": updates_per_s,
+            "unit": "site-updates/s",
+            "vs_baseline": updates_per_s / 1e9,
+        }))
+        return
+    raise SystemExit("all benchmark grids failed")
+
+
+if __name__ == "__main__":
+    main()

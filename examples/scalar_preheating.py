@@ -219,8 +219,7 @@ def main(argv=None):
                 state = carry
                 energy = compute_energy(state, expand.a)
             else:
-                current = carry[0] if isinstance(carry, tuple) else carry[1]
-                energy = compute_energy(current, expand.a)
+                energy = compute_energy(stepper.current(carry), expand.a)
 
         t += dt
         step_count += 1

@@ -63,9 +63,7 @@ class Expansion:
             self._carry = None
         else:
             self._carry = result
-            # expose the stage-updated values (low-storage steppers carry
-            # the current solution in carry[0])
-            current = result[0] if isinstance(result, tuple) else result[1]
+            current = self.stepper.current(result)
             self.a = self.dtype.type(current["a"])
             self.adot = self.dtype.type(current["adot"])
         self.hubble = self.adot / self.a

@@ -19,18 +19,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from functools import lru_cache
+import weakref
 
 from pystella_tpu import field as _field
 from pystella_tpu.ops.reduction import Reduction
 
 __all__ = ["Histogrammer", "FieldHistogrammer", "weighted_bincount"]
 
+# cache keyed weakly on the decomp so discarded decompositions (and their
+# compiled executables) remain collectable
+_bincount_cache = weakref.WeakKeyDictionary()
 
-@lru_cache(maxsize=None)
+
 def _bincount_fn(decomp, outer_shape, num_bins):
     """Build (and cache) the jitted distributed weighted-bincount for a
     given decomposition / outer shape / bin count."""
+    per_decomp = _bincount_cache.setdefault(decomp, {})
+    cached = per_decomp.get((outer_shape, num_bins))
+    if cached is not None:
+        return cached
     from jax.sharding import PartitionSpec as P
     nouter = int(np.prod(outer_shape, dtype=np.int64)) if outer_shape else 1
     spec = decomp.spec(len(outer_shape))
@@ -46,7 +53,9 @@ def _bincount_fn(decomp, outer_shape, num_bins):
                          length=num_bins * nouter)
         return decomp.psum(h).reshape(outer_shape + (num_bins,))
 
-    return jax.jit(decomp.shard_map(local, (spec, spec), out_spec))
+    fn = jax.jit(decomp.shard_map(local, (spec, spec), out_spec))
+    per_decomp[(outer_shape, num_bins)] = fn
+    return fn
 
 
 def weighted_bincount(decomp, bins, weights, num_bins):

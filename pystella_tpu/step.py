@@ -153,6 +153,12 @@ class Stepper:
     def extract(self, carry):
         raise NotImplementedError
 
+    def current(self, carry):
+        """The stage-updated solution inside a mid-step carry (what drivers
+        should read between stages, e.g. for per-stage energy reductions in
+        the reference-style loop, scalar_preheating.py:258-266)."""
+        raise NotImplementedError
+
 
 class RungeKuttaStepper(Stepper):
     """Classical explicit RK in the same bounded-copy formulation the
@@ -168,6 +174,9 @@ class RungeKuttaStepper(Stepper):
 
     def extract(self, carry):
         return carry[0]
+
+    def current(self, carry):
+        return carry[1]
 
     #: per-stage evaluation point offsets (c values) for the time argument
     _c = None
@@ -315,6 +324,9 @@ class LowStorageRKStepper(Stepper):
         return (state, k)
 
     def extract(self, carry):
+        return carry[0]
+
+    def current(self, carry):
         return carry[0]
 
     def stage(self, s, carry, t, dt, rhs_args):

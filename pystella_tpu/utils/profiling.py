@@ -19,6 +19,7 @@ __all__ = ["timer"]
 def timer(kernel, ntime=200, nwarmup=2, reps=1):
     """Average milliseconds per call of ``kernel()`` (a thunk returning jax
     arrays), with warmup; mirrors /root/reference/test/common.py:41-56."""
+    result = None
     for _ in range(nwarmup):
         result = kernel()
     jax.block_until_ready(result)
