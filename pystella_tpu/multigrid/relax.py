@@ -1,0 +1,252 @@
+"""Relaxation (smoothing) solvers for boundary-value problems L(f) = rho.
+
+TPU-native counterpart of /root/reference/pystella/multigrid/relax.py:36-373.
+The reference builds four loopy kernels per solver (stepper, residual,
+lhs-correction, residual statistics) and ping-pongs ``f``/``tmp_f`` arrays
+with a halo exchange per iteration. Here each of those becomes a jitted
+function; the whole ``nu``-iteration smooth runs as ONE compiled
+computation — a ``lax.fori_loop`` whose body fuses the stencil evaluation
+with the pointwise update, with ``lax.ppermute`` halo exchanges inside (via
+``shard_map``) on sharded levels and periodic-wrap pads on replicated
+(coarse) levels.
+
+Equations are specified as in the reference (``lhs_dict`` mapping unknown
+:class:`~pystella_tpu.Field`\\ s to ``(lhs, rho)`` pairs), with one
+TPU-first change: the Laplacian appears *symbolically* as
+``Field("lap_<name>")`` and is supplied by the solver from the
+order-``2h`` centered stencil, so the smoother's effective operator is
+exactly consistent with :class:`~pystella_tpu.FiniteDifferencer`. The
+Jacobi/Newton diagonal is ``diff(lhs, f) + diff(lhs, lap_f) * lap_diag``
+where ``lap_diag = sum_d c_0 / dx_d**2`` is the stencil's center weight
+(the chain-rule term the reference gets from symbolic stencil
+differentiation, relax.py:341-349).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pystella_tpu import field as _field
+from pystella_tpu.field import Field, Var, diff, evaluate
+from pystella_tpu.ops.derivs import (
+    SecondCenteredDifference, _apply_centered, _shifted)
+from pystella_tpu.multigrid.transfer import periodic_pad
+
+__all__ = ["LevelSpec", "RelaxationBase", "JacobiIterator", "NewtonIterator"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LevelSpec:
+    """Geometry of one multigrid level: global shape, spacing, and whether
+    its arrays are sharded over the mesh (coarse levels whose local blocks
+    would drop below the stencil halo are replicated instead — the
+    level-dependent re-decomposition the reference gets by building a
+    ``DomainDecomposition`` per level, multigrid/__init__.py:357-366)."""
+
+    grid_shape: tuple
+    dx: tuple
+    sharded: bool
+
+
+def _field_name(f):
+    if isinstance(f, _field.Field):
+        return f.name
+    if isinstance(f, str):
+        return f
+    raise TypeError(f"lhs_dict keys must be Field or str, got {type(f)}")
+
+
+class RelaxationBase:
+    """Base class for relaxation solvers (reference relax.py:36-320).
+
+    :arg decomp: a :class:`~pystella_tpu.DomainDecomposition` (used for
+        sharded levels; replicated levels need no communication).
+    :arg lhs_dict: dict ``{Field(f): (lhs, rho)}``; ``lhs`` is a symbolic
+        expression in ``Field(f)``, ``Field("lap_" + f)`` and any auxiliary
+        names; ``rho`` must be a :class:`~pystella_tpu.Field`.
+    :arg halo_shape: stencil radius ``h`` of the order-``2h`` Laplacian.
+    :arg omega: relaxation damping factor (the reference passes it via
+        ``fixed_parameters=dict(omega=...)``, which is also accepted).
+    """
+
+    def __init__(self, decomp, lhs_dict, halo_shape=1, omega=1.0,
+                 dtype=None, **kwargs):
+        self.decomp = decomp
+        self.halo_shape = int(halo_shape)
+        self.omega = float(kwargs.pop("fixed_parameters", {}).get(
+            "omega", omega))
+        self.dtype = dtype
+        self.stencil = SecondCenteredDifference(self.halo_shape)
+
+        self.f_to_rho_dict = {}
+        self.step_exprs = {}
+        self.resid_exprs = {}
+        self.lhs_exprs = {}
+        for f, (lhs, rho) in lhs_dict.items():
+            name = _field_name(f)
+            if not isinstance(rho, _field.Field):
+                raise TypeError("rho must be a Field naming the source array")
+            self.f_to_rho_dict[name] = rho.name
+            fsym = f if isinstance(f, _field.Field) else Field(name)
+            self.step_exprs[name] = self.step_operator(fsym, lhs, rho)
+            self.resid_exprs[name] = rho - lhs
+            self.lhs_exprs[name] = lhs
+        self._compiled = {}
+
+    # -- subclass hook ------------------------------------------------------
+
+    def step_operator(self, f, lhs, rho):
+        """Symbolic relaxation update for unknown ``f`` (reference
+        relax.py:140-150)."""
+        raise NotImplementedError
+
+    def _diagonal(self, f, lhs):
+        """d lhs / d f including the Laplacian's center weight."""
+        lap = Field("lap_" + f.name)
+        return diff(lhs, f) + diff(lhs, lap) * Var("_lap_diag")
+
+    # -- local stencil + environment ---------------------------------------
+
+    def _local_lap(self, x, dx, pad_fn):
+        h = self.halo_shape
+        la = x.ndim - 3
+        padded = pad_fn(x, (h,) * 3)
+        acc = None
+        for d in range(3):
+            y = padded
+            for other in range(3):
+                if other != d:
+                    y = _shifted(y, la + other, 0, h)
+            term = _apply_centered(y, la + d, self.stencil.coefs, h, 2,
+                                   1 / dx[d] ** 2)
+            acc = term if acc is None else acc + term
+        return acc
+
+    def _lap_diag(self, dx):
+        return float(sum(self.stencil.coefs[0] / d ** 2 for d in dx))
+
+    def _env(self, fs, rhos, aux, dx, pad_fn):
+        env = {**aux, **rhos, **fs}
+        for n in fs:
+            env["lap_" + n] = self._local_lap(fs[n], dx, pad_fn)
+        env["omega"] = self.omega
+        env["_lap_diag"] = self._lap_diag(dx)
+        return env
+
+    # -- compiled per-level operations --------------------------------------
+
+    def _get_compiled(self, kind, level, nu=None, decomp=None):
+        decomp = decomp if decomp is not None else self.decomp
+        key = (kind, level, nu, decomp)
+        cached = self._compiled.get(key)
+        if cached is not None:
+            return cached
+
+        pad_fn = (decomp.pad_with_halos if level.sharded
+                  else periodic_pad)
+        dx = level.dx
+
+        if kind == "smooth":
+            def body(fs, rhos, aux):
+                def it(_, fs):
+                    env = self._env(fs, rhos, aux, dx, pad_fn)
+                    return {n: evaluate(self.step_exprs[n], env)
+                            for n in fs}
+                return lax.fori_loop(0, nu, it, fs)
+        elif kind == "residual":
+            def body(fs, rhos, aux):
+                env = self._env(fs, rhos, aux, dx, pad_fn)
+                return {n: evaluate(self.resid_exprs[n], env) for n in fs}
+        elif kind == "tau":
+            # FAS coarse-grid right-hand side: restricted fine residual
+            # plus the coarse operator applied to the restricted unknowns
+            # (reference lhs_correction, relax.py:202-214)
+            def body(fs, rr, aux):
+                env = self._env(fs, {}, aux, dx, pad_fn)
+                return {self.f_to_rho_dict[n]:
+                        rr[n] + evaluate(self.lhs_exprs[n], env)
+                        for n in fs}
+        else:
+            raise ValueError(kind)
+
+        if level.sharded:
+            spec = decomp.spec(0)
+            fn = jax.jit(decomp.shard_map(body, (spec, spec, spec), spec))
+        else:
+            fn = jax.jit(body)
+        self._compiled[key] = fn
+        return fn
+
+    def _cast(self, arrays):
+        if self.dtype is None:
+            return arrays
+        return {k: jnp.asarray(v, self.dtype) for k, v in arrays.items()}
+
+    def smooth(self, level, fs, rhos, aux, iterations, decomp=None):
+        """Run ``iterations`` relaxation sweeps; returns updated unknowns."""
+        return self._get_compiled("smooth", level, int(iterations), decomp)(
+            self._cast(fs), self._cast(rhos), self._cast(aux))
+
+    def residual(self, level, fs, rhos, aux, decomp=None):
+        """``rho - L(f)`` per unknown (reference relax.py:216-223)."""
+        return self._get_compiled("residual", level, None, decomp)(
+            self._cast(fs), self._cast(rhos), self._cast(aux))
+
+    def tau_rhs(self, level, fs, restricted_resid, aux, decomp=None):
+        """Coarse-level rho with FAS tau-correction."""
+        return self._get_compiled("tau", level, None, decomp)(
+            self._cast(fs), self._cast(restricted_resid), self._cast(aux))
+
+    def get_error(self, level, fs, rhos, aux, decomp=None):
+        """L-infinity and L2 norms of the residual per unknown (reference
+        relax.py:242-266)."""
+        r = self.residual(level, fs, rhos, aux, decomp)
+        return {n: [float(jnp.max(jnp.abs(rn))),
+                    float(jnp.sqrt(jnp.mean(rn * rn)))]
+                for n, rn in r.items()}
+
+    # -- standalone relaxation (reference __call__, relax.py:164-200) -------
+
+    def __call__(self, decomp, iterations=100, dx=None, **arrays):
+        """Relax for ``iterations`` sweeps on global arrays. Unknowns, rho,
+        and auxiliary arrays are passed by keyword; returns the dict of
+        updated unknowns."""
+        if dx is None:
+            raise ValueError("dx is required")
+        if np.isscalar(dx):
+            dx = (float(dx),) * 3
+        fs = {n: arrays.pop(n) for n in self.f_to_rho_dict}
+        rhos = {r: arrays.pop(r) for r in self.f_to_rho_dict.values()}
+        first = next(iter(fs.values()))
+        sharded = (decomp is not None
+                   and any(p > 1 for p in decomp.proc_shape))
+        level = LevelSpec(tuple(first.shape[-3:]), tuple(dx), sharded)
+        return self.smooth(level, fs, rhos, arrays, iterations, decomp)
+
+
+class JacobiIterator(RelaxationBase):
+    """Damped Jacobi iteration for linear systems (reference
+    relax.py:323-349): ``f <- (1-omega) f + omega D^{-1} (rho - (L-D) f)``.
+    """
+
+    def step_operator(self, f, lhs, rho):
+        omega = Var("omega")
+        D = self._diagonal(f, lhs)
+        R_y = lhs - D * f  # valid for linear equations, as in the reference
+        return (1 - omega) * f + omega * (rho - R_y) / D
+
+
+class NewtonIterator(RelaxationBase):
+    """Newton iteration for arbitrary (nonlinear) systems (reference
+    relax.py:352-373): ``f <- f - omega (L(f) - rho) / (dL/df)``."""
+
+    def step_operator(self, f, lhs, rho):
+        omega = Var("omega")
+        D = self._diagonal(f, lhs)
+        return f - omega * (lhs - rho) / D

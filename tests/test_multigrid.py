@@ -1,0 +1,174 @@
+"""Multigrid tests (analog of /root/reference/test/test_multigrid.py:
+V-cycles on Poisson + Helmholtz must converge the residual to machine
+precision, plus transfer-operator identities and a nonlinear FAS solve)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+from pystella_tpu.multigrid import (
+    CubicInterpolation, FullApproximationScheme, FullWeighting, Injection,
+    JacobiIterator, LinearInterpolation, MultiGridSolver, NewtonIterator,
+    f_cycle, v_cycle, w_cycle)
+
+
+def make_problems():
+    """The reference's two test problems (test_multigrid.py:63-72):
+    Poisson ``lap f = rho`` and Helmholtz ``lap f2 - f2 = rho2``."""
+    return {
+        ps.Field("f"): (ps.Field("lap_f"), ps.Field("rho")),
+        ps.Field("f2"): (ps.Field("lap_f2") - ps.Field("f2"),
+                         ps.Field("rho2")),
+    }
+
+
+def zero_mean_arrays(rng, decomp, grid_shape, n):
+    out = []
+    for _ in range(n):
+        a = rng.random(grid_shape)
+        out.append(decomp.shard(a - a.mean()))
+    return out
+
+
+@pytest.mark.parametrize("h", [1])
+@pytest.mark.parametrize("Solver", [NewtonIterator, JacobiIterator])
+@pytest.mark.parametrize("MG", [FullApproximationScheme, MultiGridSolver])
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1), (2, 2, 2)],
+                         indirect=True)
+@pytest.mark.parametrize("grid_shape", [(32, 32, 32)], indirect=True)
+def test_multigrid(make_decomp, grid_shape, proc_shape, h, Solver, MG):
+    decomp = make_decomp(proc_shape)
+    dx = 10.0 / grid_shape[0]
+
+    solver = Solver(decomp, make_problems(), halo_shape=h, dtype=np.float64,
+                    fixed_parameters=dict(omega=1 / 2))
+    mg = MG(solver=solver, halo_shape=h)
+
+    rng = np.random.default_rng(5521)
+    f, rho, f2, rho2 = zero_mean_arrays(rng, decomp, grid_shape, 4)
+
+    poisson_errs, helmholtz_errs = [], []
+    for _ in range(10):
+        errs, sol = mg(decomp, dx0=dx, f=f, rho=rho, f2=f2, rho2=rho2)
+        f, f2 = sol["f"], sol["f2"]
+        poisson_errs.append(errs[-1][-1]["f"])
+        helmholtz_errs.append(errs[-1][-1]["f2"])
+
+    # same tolerance as the reference FAS check (test_multigrid.py:103-106);
+    # the linear solver matches it here because the coarse correction is
+    # zero-initialized
+    tol = 5e-14
+    for name, cycle_errs in zip(["poisson", "helmholtz"],
+                                [poisson_errs, helmholtz_errs]):
+        assert cycle_errs[-1][1] < tol and cycle_errs[-2][1] < 10 * tol, \
+            f"multigrid solution to {name} eqn inaccurate for " \
+            f"{grid_shape=}, {h=}, {proc_shape=}\n{cycle_errs=}"
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 2)], indirect=True)
+@pytest.mark.parametrize("grid_shape", [(16, 16, 16)], indirect=True)
+@pytest.mark.parametrize("cycle", [v_cycle(25, 50, 3), w_cycle(10, 20, 2),
+                                   f_cycle(10, 20, 2)])
+def test_multigrid_cycles_and_replicated_levels(make_decomp, grid_shape,
+                                                proc_shape, cycle):
+    """Deep cycles force coarse levels onto the replicated path (local
+    block of 2**3 at depth 3 on a 2x2x2 mesh is below the sharding
+    threshold)."""
+    decomp = make_decomp(proc_shape)
+    dx = 10.0 / grid_shape[0]
+    solver = NewtonIterator(decomp, make_problems(), halo_shape=1,
+                            omega=1 / 2)
+    mg = FullApproximationScheme(solver=solver, halo_shape=1)
+
+    rng = np.random.default_rng(77)
+    f, rho, f2, rho2 = zero_mean_arrays(rng, decomp, grid_shape, 4)
+    for _ in range(10):
+        errs, sol = mg(decomp, dx0=dx, cycle=cycle,
+                       f=f, rho=rho, f2=f2, rho2=rho2)
+        f, f2 = sol["f"], sol["f2"]
+    assert errs[-1][-1]["f"][1] < 5e-14
+    assert errs[-1][-1]["f2"][1] < 5e-14
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+@pytest.mark.parametrize("grid_shape", [(32, 32, 32)], indirect=True)
+def test_fas_nonlinear(make_decomp, grid_shape, proc_shape):
+    """FAS on a genuinely nonlinear problem: lap f - f + f**3 = rho. (The
+    mass term keeps the periodic constant mode well-conditioned; without
+    it the constant mode is only nonlinearly determined and relaxation
+    stalls — the situation the reference's unfinished constraint machinery,
+    relax.py:268-320, was aimed at.)"""
+    decomp = make_decomp(proc_shape)
+    dx = 10.0 / grid_shape[0]
+    f_sym = ps.Field("f")
+    problems = {f_sym: (ps.Field("lap_f") - f_sym + f_sym**3,
+                        ps.Field("rho"))}
+    solver = NewtonIterator(decomp, problems, halo_shape=1, omega=2 / 3)
+    mg = FullApproximationScheme(solver=solver, halo_shape=1)
+
+    rng = np.random.default_rng(11)
+    f, rho = zero_mean_arrays(rng, decomp, grid_shape, 2)
+    for _ in range(12):
+        errs, sol = mg(decomp, dx0=dx, f=f, rho=rho)
+        f = sol["f"]
+    assert errs[-1][-1]["f"][1] < 1e-13, errs[-1][-1]["f"]
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 2)], indirect=True)
+def test_transfer_identities(make_decomp, grid_shape, proc_shape):
+    """Restriction and interpolation preserve constants; injection picks
+    even-index points; interpolation of a coarse field reproduces it at
+    coinciding points."""
+    decomp = make_decomp(proc_shape)
+    rng = np.random.default_rng(3)
+
+    const = decomp.shard(np.full(grid_shape, 2.5))
+    for op in (FullWeighting(), Injection()):
+        out = np.asarray(op(const, decomp=decomp))
+        assert out.shape == tuple(n // 2 for n in grid_shape)
+        assert np.allclose(out, 2.5, atol=1e-13)
+
+    for op in (LinearInterpolation(), CubicInterpolation(halo_shape=2)):
+        coarse_np = rng.random(tuple(n // 2 for n in grid_shape))
+        coarse = decomp.shard(coarse_np)
+        fine = np.asarray(op(coarse, decomp=decomp))
+        assert fine.shape == tuple(grid_shape)
+        assert np.allclose(fine[::2, ::2, ::2], coarse_np, atol=1e-13)
+
+    # injection exactly picks f[2i, 2j, 2k]
+    fine_np = rng.random(grid_shape)
+    picked = np.asarray(Injection()(decomp.shard(fine_np), decomp=decomp))
+    assert np.array_equal(picked, fine_np[::2, ::2, ::2])
+
+    # full weighting of a fine field equals the explicit 27-point average
+    fw = np.asarray(FullWeighting()(decomp.shard(fine_np), decomp=decomp))
+    expect = np.zeros_like(fw)
+    w1 = {-1: 0.25, 0: 0.5, 1: 0.25}
+    for a, ca in w1.items():
+        for b, cb in w1.items():
+            for c, cc in w1.items():
+                expect += (ca * cb * cc
+                           * np.roll(fine_np, (-a, -b, -c),
+                                     (0, 1, 2))[::2, ::2, ::2])
+    assert np.allclose(fw, expect, atol=1e-13)
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_standalone_relaxation(make_decomp, grid_shape, proc_shape):
+    """Plain damped relaxation reduces the Poisson residual (reference
+    RelaxationBase.__call__, relax.py:164-200)."""
+    decomp = make_decomp(proc_shape)
+    dx = 10.0 / grid_shape[0]
+    solver = JacobiIterator(decomp, {
+        ps.Field("f"): (ps.Field("lap_f"), ps.Field("rho"))},
+        halo_shape=1, omega=1 / 2)
+
+    rng = np.random.default_rng(8)
+    f, rho = zero_mean_arrays(rng, decomp, grid_shape, 2)
+    from pystella_tpu.multigrid.relax import LevelSpec
+    level = LevelSpec(tuple(grid_shape), (dx,) * 3, True)
+
+    e0 = solver.get_error(level, {"f": f}, {"rho": rho}, {})["f"][1]
+    out = solver(decomp, iterations=200, dx=dx, f=f, rho=rho)
+    e1 = solver.get_error(level, out, {"rho": rho}, {})["f"][1]
+    assert e1 < e0 / 3, (e0, e1)
