@@ -15,14 +15,13 @@ import time
 import numpy as np
 
 
-def build_step(grid_shape, dtype=np.float32, halo_shape=2):
+def build_step(grid_shape, dtype=np.float32, halo_shape=2, fused=True):
     import jax
     import pystella_tpu as ps
 
     lattice = ps.Lattice(grid_shape, (5.0, 5.0, 5.0), dtype=dtype)
     dt = dtype(0.1 * min(lattice.dx))
     decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
-    derivs = ps.FiniteDifferencer(decomp, halo_shape, lattice.dx)
 
     mphi, gsq = 1.20e-6, 2.5e-7
 
@@ -31,13 +30,21 @@ def build_step(grid_shape, dtype=np.float32, halo_shape=2):
         return (mphi**2 / 2 * phi**2 + gsq / 2 * phi**2 * chi**2) / mphi**2
 
     sector = ps.ScalarSector(2, potential=potential)
-    sector_rhs = ps.compile_rhs_dict(sector.rhs_dict)
 
-    def full_rhs(state, t, a, hubble):
-        return sector_rhs(state, t, lap_f=derivs.lap(state["f"]),
-                          a=a, hubble=hubble)
+    if fused:
+        # fully-fused Pallas stages: stencil + KG rhs + RK update in one
+        # pass over HBM per stage
+        stepper = ps.FusedScalarStepper(sector, decomp, grid_shape,
+                                        lattice.dx, halo_shape, dtype=dtype)
+    else:
+        derivs = ps.FiniteDifferencer(decomp, halo_shape, lattice.dx)
+        sector_rhs = ps.compile_rhs_dict(sector.rhs_dict)
 
-    stepper = ps.LowStorageRK54(full_rhs, dt=dt)
+        def full_rhs(state, t, a, hubble):
+            return sector_rhs(state, t, lap_f=derivs.lap(state["f"]),
+                              a=a, hubble=hubble)
+
+        stepper = ps.LowStorageRK54(full_rhs, dt=dt)
 
     def one_step(state, t, dt, a, hubble):
         carry = stepper.init_carry(state)

@@ -28,6 +28,8 @@ from pystella_tpu.ops import (
     Reduction, FieldStatistics,
     Histogrammer, FieldHistogrammer,
 )
+from pystella_tpu.ops.pallas_stencil import StreamingStencil
+from pystella_tpu.ops.fused import FusedScalarStepper, FusedPreheatStepper
 from pystella_tpu.fourier import (
     DFT, fftfreq, pfftfreq, make_hermitian,
     Projector, PowerSpectra, RayleighGenerator,
@@ -84,6 +86,7 @@ __all__ = [
     "FirstCenteredDifference", "SecondCenteredDifference",
     "FiniteDifferencer",
     "Reduction", "FieldStatistics", "Histogrammer", "FieldHistogrammer",
+    "StreamingStencil", "FusedScalarStepper", "FusedPreheatStepper",
     "DFT", "fftfreq", "pfftfreq", "make_hermitian",
     "Projector", "PowerSpectra", "RayleighGenerator",
     "SpectralCollocator", "SpectralPoissonSolver",

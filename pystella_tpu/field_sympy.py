@@ -1,0 +1,154 @@
+"""Sympy interoperation for the symbolic field layer.
+
+TPU-native analog of the reference's sympy bridge
+(/root/reference/pystella/field/sympy.py:40-176): the reference round-trips
+pymbolic expressions through :mod:`sympy` (retaining ``Field``s via a
+``SympyField(sym.Indexed)`` subclass) so users can apply sympy's full
+simplification machinery to PDE right-hand sides before code generation.
+
+Here the same service is provided for :class:`pystella_tpu.Field`
+expressions: :func:`to_sympy` / :func:`from_sympy` convert losslessly
+(fields and indexed fields survive the round trip), and :func:`simplify`
+runs an expression through ``sympy.simplify``.
+
+Import is lazy and optional — the module degrades to a clear error if sympy
+is unavailable (it is not a hard dependency of the framework).
+"""
+
+from __future__ import annotations
+
+import numbers
+
+from pystella_tpu.field import (
+    Call, Constant, DynamicField, Expr, Field, Indexed, Power, Product,
+    Quotient, Sum, Var, _wrap,
+)
+
+__all__ = ["to_sympy", "from_sympy", "simplify", "SympyField"]
+
+
+def _sympy():
+    try:
+        import sympy
+    except ImportError as err:  # pragma: no cover
+        raise ImportError(
+            "sympy is required for pystella_tpu.field_sympy") from err
+    return sympy
+
+
+_FIELD_REGISTRY: dict = {}
+
+
+def SympyField(field, index=()):
+    """A sympy leaf that remembers the originating :class:`Field`.
+
+    The reference subclasses ``sym.Indexed`` (sympy.py:40-56); here a plain
+    ``sympy.Symbol`` with a registry entry suffices — sympy's simplification
+    treats it atomically, and :func:`from_sympy` restores the Field (and its
+    index) from the registry.
+    """
+    sym = _sympy()
+    if index:
+        name = f"{field.name}__idx__" + "_".join(map(str, index))
+    else:
+        name = field.name
+    s = sym.Symbol(name)
+    _FIELD_REGISTRY[name] = (field, tuple(index))
+    return s
+
+
+# math-function mapping, cf. reference sympy.py:58-96 (which maps e.g.
+# sympy.Abs → fabs and sympy.sign → copysign for OpenCL); here both
+# directions map by name onto the field layer's Call functions
+_TO_SYMPY_FUNCS = {
+    "exp": "exp", "log": "log", "sin": "sin", "cos": "cos", "tan": "tan",
+    "sinh": "sinh", "cosh": "cosh", "tanh": "tanh", "sqrt": "sqrt",
+    "fabs": "Abs", "sign": "sign", "arcsin": "asin", "arccos": "acos",
+    "arctan": "atan",
+}
+_FROM_SYMPY_FUNCS = {v: k for k, v in _TO_SYMPY_FUNCS.items()}
+
+
+def to_sympy(expr):
+    """Convert a field-layer expression to a sympy expression.
+
+    Analog of reference ``pymbolic_to_sympy`` (sympy.py:98-120).
+    """
+    sym = _sympy()
+    expr = _wrap(expr)
+
+    if isinstance(expr, Constant):
+        if isinstance(expr.value, numbers.Number):
+            return sym.sympify(expr.value)
+        raise TypeError("cannot convert array-valued Constant to sympy")
+    if isinstance(expr, Indexed):
+        return SympyField(expr.field, expr.index)
+    if isinstance(expr, Field):
+        return SympyField(expr)
+    if isinstance(expr, Var):
+        return sym.Symbol(expr.name)
+    if isinstance(expr, Sum):
+        return sym.Add(*(to_sympy(c) for c in expr.children))
+    if isinstance(expr, Product):
+        return sym.Mul(*(to_sympy(c) for c in expr.children))
+    if isinstance(expr, Quotient):
+        return to_sympy(expr.num) / to_sympy(expr.den)
+    if isinstance(expr, Power):
+        return sym.Pow(to_sympy(expr.base), to_sympy(expr.exponent))
+    if isinstance(expr, Call):
+        fn = getattr(sym, _TO_SYMPY_FUNCS[expr.func])
+        return fn(*(to_sympy(a) for a in expr.args))
+    raise TypeError(f"cannot convert {type(expr)} to sympy")
+
+
+def from_sympy(s_expr):
+    """Convert a sympy expression back to the field layer.
+
+    Analog of reference ``sympy_to_pymbolic`` (sympy.py:122-157). Fields
+    created by :func:`to_sympy` are restored exactly (same ``Field``
+    instance semantics, including indices).
+    """
+    sym = _sympy()
+
+    if isinstance(s_expr, sym.Symbol):
+        entry = _FIELD_REGISTRY.get(s_expr.name)
+        if entry is not None:
+            field, index = entry
+            return field[index] if index else field
+        return Var(s_expr.name)
+    if isinstance(s_expr, (sym.Integer, int)):
+        return Constant(int(s_expr))
+    if isinstance(s_expr, sym.Rational):
+        return Quotient(Constant(int(s_expr.p)), Constant(int(s_expr.q)))
+    if isinstance(s_expr, (sym.Float, float)):
+        return Constant(float(s_expr))
+    if s_expr is sym.pi:
+        import math
+        return Constant(math.pi)
+    if isinstance(s_expr, sym.Add):
+        return Sum.make(*(from_sympy(a) for a in s_expr.args))
+    if isinstance(s_expr, sym.Mul):
+        return Product.make(*(from_sympy(a) for a in s_expr.args))
+    if isinstance(s_expr, sym.Pow):
+        return Power(from_sympy(s_expr.base), from_sympy(s_expr.exp))
+    if isinstance(s_expr, sym.Function):
+        name = type(s_expr).__name__
+        if name in _FROM_SYMPY_FUNCS:
+            args = tuple(from_sympy(a) for a in s_expr.args)
+            return Call(_FROM_SYMPY_FUNCS[name], args)
+        raise ValueError(f"no mapping for sympy function {name}")
+    if s_expr.is_number:
+        return Constant(float(s_expr))
+    raise TypeError(f"cannot convert {type(s_expr)} from sympy")
+
+
+def simplify(expr, sympify=None):
+    """Simplify an expression via sympy (reference sympy.py:160-176).
+
+    :arg sympify: optional callable applied to the sympy form (defaults to
+        ``sympy.simplify``); pass e.g. ``sympy.expand`` or
+        ``sympy.factor`` for a different canonicalization.
+    """
+    sym = _sympy()
+    fn = sympify if sympify is not None else sym.simplify
+    return from_sympy(fn(to_sympy(expr)))

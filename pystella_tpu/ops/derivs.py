@@ -129,14 +129,17 @@ class FiniteDifferencer:
     :arg decomp: a :class:`~pystella_tpu.DomainDecomposition`.
     :arg halo_shape: the stencil radius ``h`` (1..4 → order 2..8).
     :arg dx: lattice spacing per axis (scalar or 3-tuple).
-    :arg mode: ``"halo"`` (shard_map + ppermute halos, default) or
-        ``"roll"`` (global jnp.roll; XLA infers collectives).
+    :arg mode: ``"pallas"`` (streaming Pallas stencil kernels — the fast
+        TPU path, default on unsharded lattices), ``"halo"`` (shard_map +
+        ppermute halos, XLA stencils) or ``"roll"`` (global jnp.roll; XLA
+        infers collectives). ``"auto"`` picks pallas when the lattice y/z
+        axes are unsharded, else halo.
     """
 
     def __init__(self, decomp, halo_shape, dx, *, rank_shape=None,
                  first_stencil_factory=FirstCenteredDifference,
                  stencil_factory=SecondCenteredDifference,
-                 mode="halo", **kwargs):
+                 mode="auto", **kwargs):
         self.decomp = decomp
         self.h = int(halo_shape)
         if np.isscalar(dx):
@@ -144,8 +147,16 @@ class FiniteDifferencer:
         self.dx = tuple(float(d) for d in dx)
         self.first = first_stencil_factory(self.h)
         self.second = stencil_factory(self.h)
-        if mode not in ("halo", "roll"):
+        if mode == "auto":
+            py, pz = decomp.proc_shape[1], decomp.proc_shape[2]
+            mode = "pallas" if (py == 1 and pz == 1
+                               and self.h <= 8) else "halo"
+        if mode not in ("halo", "roll", "pallas"):
             raise ValueError(f"unknown mode {mode}")
+        if mode == "pallas" and (decomp.proc_shape[1] != 1
+                                 or decomp.proc_shape[2] != 1):
+            raise ValueError(
+                "pallas mode supports sharding only along x; use halo mode")
         self.mode = mode
         self._sharded_cache = {}
 
@@ -261,7 +272,143 @@ class FiniteDifferencer:
         outer = x.ndim - 3 - (1 if vector_in else 0)
         if self.mode == "roll":
             return self._roll_dispatch(name, x)
+        if self.mode == "pallas":
+            return self._pallas_dispatch(name, x, vector_in)
         return self._sharded(name, outer, extra_out_axis, vector_in)(x)
+
+    # -- pallas-mode bodies (streaming VMEM-window kernels) -----------------
+
+    def _pallas_bodies(self, name, n_out):
+        """Kernel body for op ``name`` on a window of ``C`` components
+        (``C = 3*n_out`` for divergence)."""
+        inv_dx = [1.0 / d for d in self.dx]
+        inv_dx2 = [1.0 / d**2 for d in self.dx]
+        first, second = self.first.coefs, self.second.coefs
+
+        from pystella_tpu.ops.pallas_stencil import (
+            grad_from_taps, lap_from_taps)
+
+        def off(d, s):
+            o = [0, 0, 0]
+            o[d] = s
+            return o
+
+        def lap_of(taps):
+            return lap_from_taps(taps, second, inv_dx2)
+
+        def grad_of(taps):
+            return jnp.stack(grad_from_taps(taps, first, inv_dx), axis=1)
+
+        if name == "lap":
+            return lambda taps, e, s: {"lap": lap_of(taps)}
+        if name == "grad":
+            return lambda taps, e, s: {"grad": grad_of(taps)}
+        if name == "grad_lap":
+            return lambda taps, e, s: {"grad": grad_of(taps),
+                                       "lap": lap_of(taps)}
+        if name in ("pdx", "pdy", "pdz"):
+            d = {"pdx": 0, "pdy": 1, "pdz": 2}[name]
+
+            def pd_body(taps, e, s, d=d):
+                acc = 0
+                for st, c in first.items():
+                    acc = acc + c * inv_dx[d] * (taps(*off(d, st))
+                                                 - taps(*off(d, -st)))
+                return {"pd": acc}
+            return pd_body
+        if name == "div":
+            def div_body(taps, e, s):
+                acc = 0
+                for d in range(3):
+                    for st, c in first.items():
+                        diffv = taps(*off(d, st)) - taps(*off(d, -st))
+                        sel = diffv.reshape((n_out, 3)
+                                            + diffv.shape[1:])[:, d]
+                        acc = acc + c * inv_dx[d] * sel
+                return {"div": acc}
+            return div_body
+        raise ValueError(name)
+
+    def _pallas_op(self, name, n_comp, dtype, vector_in, global_shape):
+        from pystella_tpu.ops.pallas_stencil import StreamingStencil
+
+        key = ("pallas", name, n_comp, str(dtype), vector_in, global_shape)
+        cached = self._sharded_cache.get(key)
+        if cached is not None:
+            return cached
+
+        px = self.decomp.proc_shape[0]
+        local_shape = (global_shape[0] // px,) + tuple(global_shape[1:])
+        n_out = n_comp // 3 if vector_in else n_comp
+        out_defs = {"lap": {"lap": (n_out,)},
+                    "grad": {"grad": (n_out, 3)},
+                    "grad_lap": {"grad": (n_out, 3), "lap": (n_out,)},
+                    "pdx": {"pd": (n_out,)}, "pdy": {"pd": (n_out,)},
+                    "pdz": {"pd": (n_out,)},
+                    "div": {"div": (n_out,)}}[name]
+        body = self._pallas_bodies(name, n_out)
+        st = StreamingStencil(local_shape, {"f": n_comp}, self.h, body,
+                              out_defs, dtype=dtype, x_halo=(px > 1))
+
+        if px > 1:
+            h = self.h
+            decomp = self.decomp
+
+            def sharded_fn(x):
+                xpad = decomp.pad_with_halos(x, (h, 0, 0))
+                return tuple(st(xpad).values())
+
+            import jax as _jax
+            in_spec = decomp.spec(1)
+            out_specs = tuple(
+                decomp.spec(len(lead)) for lead in out_defs.values())
+            fn = _jax.jit(decomp.shard_map(
+                sharded_fn, in_spec,
+                out_specs if len(out_specs) > 1 else out_specs[0],
+                check_vma=False))
+
+            def call(x, fn=fn):
+                res = fn(x)
+                if not isinstance(res, tuple):
+                    res = (res,)
+                return dict(zip(out_defs, res))
+        else:
+            call = st
+
+        self._sharded_cache[key] = call
+        return call
+
+    def _pallas_dispatch(self, name, x, vector_in=False):
+        # flatten outer axes (and the vector axis for div) into one
+        # component axis for the window
+        lat = tuple(x.shape[-3:])
+        outer = x.shape[:-3]
+        n_comp = int(np.prod(outer)) if outer else 1
+        try:
+            op = self._pallas_op(name, n_comp, x.dtype, vector_in, lat)
+        except ValueError:
+            # no feasible (bx, by) blocking for this lattice (e.g. axes not
+            # divisible by any block size): fall back to the XLA halo path
+            n_outer = len(outer) - (1 if vector_in else 0)
+            extra = name in ("grad", "grad_lap")
+            return self._sharded(name, n_outer, extra, vector_in)(x)
+        xf = x.reshape((n_comp,) + lat)
+        res = op(xf)
+        n_out = n_comp // 3 if vector_in else n_comp
+        out_outer = outer[:-1] if vector_in else outer
+
+        def unflatten(arr, lead):
+            return arr.reshape(tuple(out_outer) + tuple(lead[1:])
+                               + tuple(arr.shape[-3:]))
+
+        if name == "grad_lap":
+            lead = {"grad": (n_out, 3), "lap": (n_out,)}
+            return (unflatten(res["grad"], lead["grad"]),
+                    unflatten(res["lap"], lead["lap"]))
+        out_name = next(iter(res))
+        lead = {"lap": (n_out,), "grad": (n_out, 3), "pd": (n_out,),
+                "div": (n_out,)}[out_name]
+        return unflatten(res[out_name], lead)
 
     def _roll_dispatch(self, name, x):
         la = x.ndim - 3

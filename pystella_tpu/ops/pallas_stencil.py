@@ -1,0 +1,435 @@
+"""Streaming Pallas-TPU stencil kernels.
+
+The TPU-native equivalent of the reference's local-memory-prefetch stencil
+kernels (/root/reference/pystella/stencil.py:36-143, esp. the
+``StreamingStencil`` that marches a prefetch window along one axis,
+stencil.py:113-143). XLA's fusion handles elementwise maps well but
+materializes relayouts for shifted slices on the tiled (sublane, lane)
+dimensions, so high-order finite-difference operators run far below HBM
+bandwidth; these kernels recover it.
+
+Design (chosen by microbenchmark on TPU v5e):
+
+- Arrays are ``(C, X, Y, Z)`` with lattice axes trailing. ``Z`` (the lane
+  dimension) is kept whole in VMEM; z-shifts are in-register lane rolls with
+  free periodic wrap. ``Y`` (sublane) is split into blocks ``by`` with an
+  8-aligned halo window; the y-offset is static per y-block (one
+  ``pallas_call`` per y-block) because Mosaic requires provably-aligned
+  sublane DMA offsets. ``X`` (untiled) is streamed: grid programs advance
+  ``bx`` rows at a time; a persistent VMEM ring of 4 x-blocks holds the
+  stencil window and each program DMAs only its one new block —
+  amplification ~1, contiguous descriptors, issued one program ahead
+  (double buffering).
+- Periodic wrap: x via block-index modulo, y via static piecewise DMAs at
+  the edge y-blocks, z via the lane roll.
+- ``x_halo=True`` instead reads an input whose x-axis is pre-padded with
+  ``h`` halo rows (filled by the mesh halo exchange — the sharded path);
+  each program then DMAs its own haloed window directly (no ring).
+
+The kernel body is arbitrary traced JAX: finite-difference taps, fused
+Runge-Kutta stage updates (see :mod:`pystella_tpu.ops.fused`), multigrid
+smoothers. On CPU backends the kernels run in Pallas interpret mode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["StreamingStencil", "Taps", "HY", "choose_blocks",
+           "lap_from_taps", "grad_from_taps"]
+
+#: aligned y-halo width (one sublane tile); must be >= the stencil radius
+HY = 8
+
+_RING = 4  # x-block ring slots: 3 live + 1 in flight
+
+
+def _is_cpu():
+    return jax.default_backend() == "cpu"
+
+
+def _rem(a, m):
+    """int32-safe modulo for grid indices (x64 mode promotes literals)."""
+    return jax.lax.rem(jnp.asarray(a, jnp.int32), jnp.int32(m))
+
+
+def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
+                  budget=10 * 2**20):
+    """Pick ``(bx, by)`` fitting the VMEM budget: the window ring, the
+    double-buffered extra inputs / outputs, and ~3 window-sized compute
+    temporaries."""
+    X, Y, Z = lattice_shape
+    best = None
+    for by in (256, 128, 64, 32, 16, 8):
+        if by > Y or Y % by:
+            continue
+        for bx in (16, 8, 4, 2, 1):
+            if bx > X or X % bx or bx < h:
+                continue
+            byw = by + 2 * HY
+            win = n_comp * _RING * bx * byw * Z * itemsize
+            temps = 3 * n_comp * (bx + 2 * h) * byw * Z * itemsize
+            io = 2 * (n_extra + n_out) * bx * by * Z * itemsize
+            if win + temps + io <= budget:
+                if best is None or bx * by > best[0] * best[1]:
+                    best = (bx, by)
+    if best is None:
+        bx = next((b for b in (8, 4, 2, 1) if X % b == 0 and b >= h), 1)
+        return bx, 8
+    return best
+
+
+class Taps:
+    """Stencil-tap accessor handed to kernel bodies.
+
+    ``taps(sx, sy, sz)`` returns the windowed field shifted by the given
+    static offsets, shaped ``(C, bx, by, Z)``. ``|sx|, |sy| <= h``;
+    ``sz`` may only be nonzero alone (axis-aligned centered-difference
+    taps); z wraps periodically (whole axis in VMEM), x/y shifts read the
+    window halo."""
+
+    def __init__(self, w, h, bx, by, Z, interpret):
+        self._w = w
+        self._h, self._bx, self._by, self._Z = h, bx, by, Z
+        self._interpret = interpret
+        self._cache = {}
+
+    def __call__(self, sx=0, sy=0, sz=0):
+        key = (sx, sy, sz)
+        if key in self._cache:
+            return self._cache[key]
+        h, bx, by, Z = self._h, self._bx, self._by, self._Z
+        if sz != 0:
+            if sx or sy:
+                raise ValueError("taps must be axis-aligned")
+            c = self()
+            if self._interpret:
+                out = jnp.roll(c, -sz, axis=3)
+            else:
+                out = pltpu.roll(c, (Z - sz) % Z, 3)
+        else:
+            out = self._w[:, h + sx:h + sx + bx, HY + sy:HY + sy + by, :]
+        self._cache[key] = out
+        return out
+
+
+def lap_from_taps(taps, coefs, inv_dx2):
+    """Laplacian from centered-difference taps: ``coefs`` maps offset ->
+    coefficient (offset 0 included), ``inv_dx2`` is ``1/dx**2`` per axis."""
+    acc = coefs[0] * sum(inv_dx2) * taps()
+    for s, c in coefs.items():
+        if s == 0:
+            continue
+        acc += c * inv_dx2[0] * (taps(s) + taps(-s))
+        acc += c * inv_dx2[1] * (taps(0, s) + taps(0, -s))
+        acc += c * inv_dx2[2] * (taps(0, 0, s) + taps(0, 0, -s))
+    return acc
+
+
+def grad_from_taps(taps, coefs, inv_dx):
+    """Per-axis first derivatives from antisymmetric centered taps; returns
+    a list of three ``(C, bx, by, Z)`` blocks."""
+    grads = []
+    for d in range(3):
+        acc = 0
+        for s, c in coefs.items():
+            plus = [0, 0, 0]
+            plus[d] = s
+            minus = [0, 0, 0]
+            minus[d] = -s
+            acc = acc + c * inv_dx[d] * (taps(*plus) - taps(*minus))
+        grads.append(acc)
+    return grads
+
+
+class StreamingStencil:
+    """Builds and calls streaming-window Pallas stencil kernels.
+
+    :arg lattice_shape: local interior ``(X, Y, Z)``.
+    :arg win_defs: dict name -> leading component count, one entry per
+        *windowed* (haloed) input; a bare int means a single input named
+        ``"f"``.
+    :arg h: stencil radius (<= HY).
+    :arg body: ``body(taps, extras, scalars) -> dict`` mapping each output
+        name to a ``(*lead, bx, by, Z)`` block. With several windowed
+        inputs ``taps`` is a dict name -> :class:`Taps`.
+    :arg out_defs: dict output name -> leading shape tuple.
+    :arg extra_defs: dict input name -> leading shape tuple; same-lattice
+        unhaloed arrays, pipelined blockwise.
+    :arg scalar_names: names of runtime scalars (handed to the body).
+    :arg x_halo: the input x-axis is pre-padded with ``h`` halo rows
+        (sharded x); otherwise periodic wrap in-kernel.
+    """
+
+    def __init__(self, lattice_shape, win_defs, h, body, out_defs,
+                 extra_defs=None, scalar_names=(), dtype=jnp.float32,
+                 bx=None, by=None, x_halo=False, interpret=None):
+        if h > HY:
+            raise ValueError(f"stencil radius {h} exceeds aligned halo {HY}")
+        self.lattice_shape = X, Y, Z = tuple(int(s) for s in lattice_shape)
+        if not isinstance(win_defs, dict):
+            win_defs = {"f": int(win_defs)}
+        self.win_defs = {k: int(v) for k, v in win_defs.items()}
+        self.single_window = len(self.win_defs) == 1
+        self.h = int(h)
+        self.body = body
+        self.out_defs = {k: tuple(v) for k, v in dict(out_defs).items()}
+        self.extra_defs = {k: tuple(v)
+                           for k, v in dict(extra_defs or {}).items()}
+        self.scalar_names = tuple(scalar_names)
+        self.dtype = jnp.dtype(dtype)
+        if bx is None or by is None:
+            cbx, cby = choose_blocks(
+                sum(self.win_defs.values()), self.lattice_shape, self.h,
+                self.dtype.itemsize,
+                sum(int(np.prod(s)) if s else 1
+                    for s in self.extra_defs.values()),
+                sum(int(np.prod(s)) if s else 1
+                    for s in self.out_defs.values()))
+            bx = bx if bx is not None else cbx
+            by = by if by is not None else cby
+        if X % bx or Y % by:
+            raise ValueError(
+                f"block ({bx},{by}) must divide lattice ({X},{Y})")
+        if bx < self.h and X // bx > 1:
+            raise ValueError(f"bx={bx} must be >= stencil radius {self.h}")
+        self.bx, self.by = int(bx), int(by)
+        self.x_halo = bool(x_halo)
+        self.interpret = _is_cpu() if interpret is None else interpret
+        self._calls = [self._build(j) for j in range(Y // self.by)]
+
+    # -- construction ------------------------------------------------------
+
+    def _y_pieces(self, j):
+        """Static (src_y0, dst_y0, n) DMA pieces for the y-window of block
+        j, with periodic wrap at the global y edges."""
+        X, Y, Z = self.lattice_shape
+        by, byw = self.by, self.by + 2 * HY
+        nby = Y // by
+        y0 = j * by - HY
+        if nby == 1:
+            return [(Y - HY, 0, HY), (0, HY, Y), (0, HY + Y, HY)]
+        if j == 0:
+            return [(Y - HY, 0, HY), (0, HY, by + HY)]
+        if j == nby - 1:
+            return [(y0, 0, by + HY), (0, by + HY, HY)]
+        return [(y0, 0, byw)]
+
+    def _make_specs(self, j):
+        """(in_specs, out_specs, out_shapes) shared by both kernel modes.
+        Outputs are y-slabs ``(*lead, X, by, Z)``."""
+        X, Y, Z = self.lattice_shape
+        bx, by = self.bx, self.by
+
+        def block_spec(lead, yidx):
+            nlead = len(lead)
+
+            def index_map(i, nlead=nlead, yidx=yidx):
+                return (0,) * nlead + (i, yidx, 0)
+
+            return pl.BlockSpec(tuple(lead) + (bx, by, Z), index_map)
+
+        in_specs = [pl.BlockSpec(memory_space=pl.ANY)
+                    for _ in self.win_defs]
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)
+                     for _ in self.scalar_names]
+        in_specs += [block_spec(self.extra_defs[n], j)
+                     for n in self.extra_defs]
+        out_specs = [block_spec(self.out_defs[n], 0) for n in self.out_defs]
+        out_shapes = [
+            jax.ShapeDtypeStruct(self.out_defs[n] + (X, by, Z), self.dtype)
+            for n in self.out_defs]
+        return in_specs, out_specs, out_shapes
+
+    def _unpack_refs(self, refs):
+        nw, ns, ne, no = (len(self.win_defs), len(self.scalar_names),
+                          len(self.extra_defs), len(self.out_defs))
+        f_refs = refs[:nw]
+        scalar_refs = refs[nw:nw + ns]
+        extra_refs = refs[nw + ns:nw + ns + ne]
+        out_refs = refs[nw + ns + ne:nw + ns + ne + no]
+        wins, sem = refs[-nw - 1:-1], refs[-1]
+        return f_refs, scalar_refs, extra_refs, out_refs, wins, sem
+
+    def _run_body(self, ws, scalar_refs, extra_refs, out_refs):
+        X, Y, Z = self.lattice_shape
+        taps = {n: Taps(w, self.h, self.bx, self.by, Z, self.interpret)
+                for n, w in zip(self.win_defs, ws)}
+        if self.single_window:
+            taps = next(iter(taps.values()))
+        scalars = {n: r[0] for n, r in zip(self.scalar_names, scalar_refs)}
+        extras = {n: r[...] for n, r in zip(self.extra_defs, extra_refs)}
+        outs = self.body(taps, extras, scalars)
+        for n, ref in zip(self.out_defs, out_refs):
+            ref[...] = outs[n]
+
+    def _build(self, j):
+        if self.x_halo:
+            return self._build_xhalo(j)
+        X, Y, Z = self.lattice_shape
+        h, bx, by = self.h, self.bx, self.by
+        byw = by + 2 * HY
+        nbx = X // bx
+        R = _RING
+        ypieces = self._y_pieces(j)
+
+        def block_dmas(f_ref, win, sem, blk, slot):
+            b = _rem(blk + nbx, nbx)
+            return [pltpu.make_async_copy(
+                f_ref.at[:, pl.ds(b * bx, bx), pl.ds(sy0, n), :],
+                win.at[:, pl.ds(slot * bx, bx), pl.ds(dy0, n), :],
+                sem.at[_rem(slot, 2)]) for sy0, dy0, n in ypieces]
+
+        def kernel(*refs):
+            f_refs, scalar_refs, extra_refs, out_refs, wins, sem = \
+                self._unpack_refs(refs)
+            i = pl.program_id(0)
+
+            def start(blk, slot):
+                for f_ref, win in zip(f_refs, wins):
+                    for d in block_dmas(f_ref, win, sem, blk, slot):
+                        d.start()
+
+            def wait(blk, slot):
+                for f_ref, win in zip(f_refs, wins):
+                    for d in block_dmas(f_ref, win, sem, blk, slot):
+                        d.wait()
+
+            if nbx <= 2:
+                # all blocks (-1..nbx) fit in the ring: fetch once at i==0
+                @pl.when(i == 0)
+                def _():
+                    for blk in range(-1, nbx + 1):
+                        start(blk, (blk + R) % R)
+                        wait(blk, (blk + R) % R)
+            else:
+                @pl.when(i == 0)
+                def _():
+                    for db in (-1, 0, 1):
+                        start(db, (db + R) % R)
+                        wait(db, (db + R) % R)
+                    start(2, 2)
+
+                @pl.when(i > 0)
+                def _():
+                    wait(i + 1, _rem(i + 1, R))
+
+                    @pl.when(i < nbx - 1)
+                    def _():
+                        start(i + 2, _rem(i + 2, R))
+
+            sl = [_rem(i + db + R, R) for db in (-1, 0, 1)]
+            ws = []
+            for win in wins:
+                prev = win[:, pl.ds(sl[0] * bx + bx - h, h), :, :]
+                cur = win[:, pl.ds(sl[1] * bx, bx), :, :]
+                nxt = win[:, pl.ds(sl[2] * bx, h), :, :]
+                ws.append(jnp.concatenate([prev, cur, nxt], axis=1))
+            self._run_body(ws, scalar_refs, extra_refs, out_refs)
+
+        in_specs, out_specs, out_shapes = self._make_specs(j)
+        return pl.pallas_call(
+            kernel,
+            grid=(nbx,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            scratch_shapes=[
+                pltpu.VMEM((C, R * bx, byw, Z), self.dtype)
+                for C in self.win_defs.values()
+            ] + [pltpu.SemaphoreType.DMA((2,))],
+            interpret=self.interpret,
+        )
+
+    def _build_xhalo(self, j):
+        """Sharded-x variant: input rows are pre-padded ``(C, X+2h, Y, Z)``;
+        each program DMAs its own haloed window (double-buffered)."""
+        X, Y, Z = self.lattice_shape
+        h, bx, by = self.h, self.bx, self.by
+        bxw, byw = bx + 2 * h, by + 2 * HY
+        nbx = X // bx
+        ypieces = self._y_pieces(j)
+
+        def win_dmas(f_ref, win, sem, i, slot):
+            return [pltpu.make_async_copy(
+                f_ref.at[:, pl.ds(i * bx, bxw), pl.ds(sy0, n), :],
+                win.at[:, pl.ds(slot * bxw, bxw), pl.ds(dy0, n), :],
+                sem.at[slot]) for sy0, dy0, n in ypieces]
+
+        def kernel(*refs):
+            f_refs, scalar_refs, extra_refs, out_refs, wins, sem = \
+                self._unpack_refs(refs)
+            i = pl.program_id(0)
+
+            def start(ii, slot):
+                for f_ref, win in zip(f_refs, wins):
+                    for d in win_dmas(f_ref, win, sem, ii, slot):
+                        d.start()
+
+            def wait(ii, slot):
+                for f_ref, win in zip(f_refs, wins):
+                    for d in win_dmas(f_ref, win, sem, ii, slot):
+                        d.wait()
+
+            @pl.when(i == 0)
+            def _():
+                start(0, 0)
+
+            slot = _rem(i, 2)
+            wait(i, slot)
+
+            if nbx > 1:
+                @pl.when(i < nbx - 1)
+                def _():
+                    start(i + 1, _rem(i + 1, 2))
+
+            ws = [win[:, pl.ds(slot * bxw, bxw), :, :] for win in wins]
+            self._run_body(ws, scalar_refs, extra_refs, out_refs)
+
+        in_specs, out_specs, out_shapes = self._make_specs(j)
+        return pl.pallas_call(
+            kernel,
+            grid=(nbx,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            scratch_shapes=[
+                pltpu.VMEM((C, 2 * bxw, byw, Z), self.dtype)
+                for C in self.win_defs.values()
+            ] + [pltpu.SemaphoreType.DMA((2,))],
+            interpret=self.interpret,
+        )
+
+    # -- invocation --------------------------------------------------------
+
+    def __call__(self, f, scalars=None, extras=None):
+        """Apply to the windowed input(s) ``f`` — a single array (shape
+        ``(n_comp, X, Y, Z)``, or x-padded ``(n_comp, X+2h, Y, Z)`` with
+        ``x_halo``) or a dict name -> array matching ``win_defs``. Returns
+        a dict of named full-lattice outputs."""
+        scalars = scalars or {}
+        extras = extras or {}
+        if isinstance(f, dict):
+            win_args = [f[n] for n in self.win_defs]
+        else:
+            win_args = [f]
+        scalar_args = [jnp.asarray(scalars[n], self.dtype).reshape(1)
+                       for n in self.scalar_names]
+        extra_args = [extras[n] for n in self.extra_defs]
+        out_names = list(self.out_defs)
+        nby = self.lattice_shape[1] // self.by
+
+        slabs = [call(*win_args, *scalar_args, *extra_args)
+                 for call in self._calls]
+        if nby == 1:
+            return dict(zip(out_names, slabs[0]))
+        out = {}
+        for k, n in enumerate(out_names):
+            yax = len(self.out_defs[n]) + 1  # y axis of (*lead, X, by, Z)
+            out[n] = jnp.concatenate([s[k] for s in slabs], axis=yax)
+        return out

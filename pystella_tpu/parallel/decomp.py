@@ -228,10 +228,13 @@ class DomainDecomposition:
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=spec, out_specs=spec))(array)
 
-    def shard_map(self, fn, in_specs, out_specs):
-        """Thin wrapper over ``jax.shard_map`` bound to this mesh."""
+    def shard_map(self, fn, in_specs, out_specs, **kwargs):
+        """Thin wrapper over ``jax.shard_map`` bound to this mesh.
+        ``check_vma=False`` is needed for bodies containing ``pallas_call``
+        (whose outputs carry no varying-mesh-axes annotation)."""
         return jax.shard_map(fn, mesh=self.mesh,
-                             in_specs=in_specs, out_specs=out_specs)
+                             in_specs=in_specs, out_specs=out_specs,
+                             **kwargs)
 
     # -- bookkeeping matching reference get_rank_shape_start ----------------
 

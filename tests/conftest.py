@@ -28,8 +28,10 @@ import jax  # noqa: E402
 # mesh, so drop the factory before any backend is initialized.
 from jax._src import xla_bridge as _xb  # noqa: E402
 
-for _name in ("axon", "tpu"):
-    _xb._backend_factories.pop(_name, None)
+# pop only the axon plugin: removing the standard "tpu" factory would
+# deregister the platform and break jax.experimental.pallas imports
+# (checkify registers a tpu lowering rule at import time)
+_xb._backend_factories.pop("axon", None)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)  # reference defaults to float64
 

@@ -1,0 +1,117 @@
+"""Fused Pallas RK stages must agree with the generic (unfused) path
+bit-for-bit up to fp roundoff (reference semantics:
+scalar_preheating.py:258-266 stage loop = stencil + RK-stage kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+from pystella_tpu.ops.fused import FusedPreheatStepper, FusedScalarStepper
+
+
+@pytest.fixture
+def decomp():
+    return ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+
+
+def _potential(f):
+    return 0.5 * 1.2e-2 * f[0] ** 2 + 0.125 * f[0] ** 2 * f[1] ** 2
+
+
+def _generic_step(decomp, grid_shape, dx, h, state, dt, a, hubble,
+                  gravitational_waves=False):
+    derivs = ps.FiniteDifferencer(decomp, h, dx)
+    sector = ps.ScalarSector(2, potential=_potential)
+    sectors = [sector]
+    if gravitational_waves:
+        sectors.append(ps.TensorPerturbationSector([sector]))
+    merged = {}
+    for s in sectors:
+        merged.update(s.rhs_dict)
+    rhs = ps.compile_rhs_dict(merged)
+
+    def full_rhs(st, t, a, hubble):
+        aux = {"lap_f": derivs.lap(st["f"]), "a": a, "hubble": hubble}
+        if gravitational_waves:
+            aux["dfdx"] = derivs.grad(st["f"])
+            aux["lap_hij"] = derivs.lap(st["hij"])
+        return rhs(st, t, **aux)
+
+    stepper = ps.LowStorageRK54(full_rhs, dt=dt)
+    return stepper.step(state, 0.0, dt, {"a": a, "hubble": hubble})
+
+
+def test_fused_scalar_matches_generic(decomp):
+    grid_shape = (16, 16, 16)
+    h, dx = 2, (0.3, 0.25, 0.2)
+    dt = 0.01
+    rng = np.random.default_rng(5)
+    state = {
+        "f": jnp.asarray(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": jnp.asarray(0.1 * rng.standard_normal((2,) + grid_shape)),
+    }
+    a, hubble = 1.3, 0.21
+
+    ref = _generic_step(decomp, grid_shape, dx, h, state, dt, a, hubble)
+
+    sector = ps.ScalarSector(2, potential=_potential)
+    fused = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                               dtype=jnp.float64, bx=4, by=8)
+    got = fused.step(state, 0.0, dt, {"a": a, "hubble": hubble})
+
+    for name in ("f", "dfdt"):
+        err = np.max(np.abs(np.asarray(got[name]) - np.asarray(ref[name])))
+        scale = np.max(np.abs(np.asarray(ref[name])))
+        assert err / scale < 1e-12, (name, err, scale)
+
+
+def test_fused_scalar_per_stage_interface(decomp):
+    """The per-stage __call__ protocol matches step()."""
+    grid_shape = (16, 16, 16)
+    h, dx, dt = 1, 0.3, 0.02
+    rng = np.random.default_rng(6)
+    state = {
+        "f": jnp.asarray(rng.standard_normal((1,) + grid_shape)),
+        "dfdt": jnp.asarray(rng.standard_normal((1,) + grid_shape)),
+    }
+    sector = ps.ScalarSector(1, potential=lambda f: 0.5 * f[0] ** 2)
+    fused = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                               dtype=jnp.float64, bx=4, by=8)
+
+    whole = fused.step(state, 0.0, dt, {"a": 1.0, "hubble": 0.0})
+    carry = state
+    for s in range(fused.num_stages):
+        carry = fused(s, carry, 0.0, dt, a=1.0, hubble=0.0)
+    for name in ("f", "dfdt"):
+        assert np.allclose(np.asarray(whole[name]), np.asarray(carry[name]),
+                           rtol=1e-13, atol=1e-13)
+
+
+def test_fused_preheat_matches_generic(decomp):
+    grid_shape = (16, 16, 16)
+    h, dx = 2, 0.3
+    dt = 0.01
+    rng = np.random.default_rng(7)
+    state = {
+        "f": jnp.asarray(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": jnp.asarray(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "hij": jnp.asarray(1e-3 * rng.standard_normal((6,) + grid_shape)),
+        "dhijdt": jnp.asarray(1e-4 * rng.standard_normal((6,) + grid_shape)),
+    }
+    a, hubble = 1.1, 0.13
+
+    ref = _generic_step(decomp, grid_shape, (dx,) * 3, h, state, dt, a,
+                        hubble, gravitational_waves=True)
+
+    sector = ps.ScalarSector(2, potential=_potential)
+    gw = ps.TensorPerturbationSector([sector])
+    fused = FusedPreheatStepper(sector, gw, decomp, grid_shape, dx, h,
+                                dtype=jnp.float64, bx=4, by=8)
+    got = fused.step(state, 0.0, dt, {"a": a, "hubble": hubble})
+
+    for name in ("f", "dfdt", "hij", "dhijdt"):
+        err = np.max(np.abs(np.asarray(got[name]) - np.asarray(ref[name])))
+        scale = max(np.max(np.abs(np.asarray(ref[name]))), 1e-30)
+        assert err / scale < 1e-11, (name, err, scale)
