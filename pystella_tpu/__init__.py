@@ -39,7 +39,7 @@ from pystella_tpu.models import (
     Sector, ScalarSector, TensorPerturbationSector, tensor_index,
     get_rho_and_p, Expansion,
 )
-from pystella_tpu.utils import OutputFile, timer
+from pystella_tpu.utils import Checkpointer, OutputFile, timer
 from pystella_tpu.step import (
     Stepper, RungeKuttaStepper, LowStorageRKStepper, compile_rhs_dict,
     RungeKutta4, RungeKutta3Heun, RungeKutta3Nystrom, RungeKutta3Ralston,
@@ -91,7 +91,7 @@ __all__ = [
     "Projector", "PowerSpectra", "RayleighGenerator",
     "SpectralCollocator", "SpectralPoissonSolver",
     "Sector", "ScalarSector", "TensorPerturbationSector", "tensor_index",
-    "get_rho_and_p", "Expansion", "OutputFile", "timer",
+    "get_rho_and_p", "Expansion", "OutputFile", "timer", "Checkpointer",
     "Stepper", "RungeKuttaStepper", "LowStorageRKStepper", "compile_rhs_dict",
     "RungeKutta4", "RungeKutta3Heun", "RungeKutta3Nystrom",
     "RungeKutta3Ralston", "RungeKutta3SSP", "RungeKutta2Midpoint",

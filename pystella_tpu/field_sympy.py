@@ -53,6 +53,12 @@ def SympyField(field, index=()):
     else:
         name = field.name
     s = sym.Symbol(name)
+    prior = _FIELD_REGISTRY.get(name)
+    if prior is not None and prior[0]._key() != field._key():
+        raise ValueError(
+            f"sympy round-trip name collision: two distinct Fields both "
+            f"map to symbol {name!r} ({prior[0]!r} vs {field!r}); rename "
+            f"one of them")
     _FIELD_REGISTRY[name] = (field, tuple(index))
     return s
 

@@ -1,5 +1,5 @@
 from pystella_tpu.fourier.dft import (
-    DFT, fftfreq, pfftfreq, make_hermitian,
+    DFT, fftfreq, pfftfreq, make_hermitian, get_sliced_momenta,
     get_real_dtype_with_matching_prec, get_complex_dtype_with_matching_prec,
 )
 from pystella_tpu.fourier.projectors import Projector, tensor_index
@@ -9,7 +9,7 @@ from pystella_tpu.fourier.derivs import SpectralCollocator
 from pystella_tpu.fourier.poisson import SpectralPoissonSolver
 
 __all__ = [
-    "DFT", "fftfreq", "pfftfreq", "make_hermitian",
+    "DFT", "fftfreq", "pfftfreq", "make_hermitian", "get_sliced_momenta",
     "get_real_dtype_with_matching_prec",
     "get_complex_dtype_with_matching_prec",
     "Projector", "tensor_index", "PowerSpectra", "RayleighGenerator",

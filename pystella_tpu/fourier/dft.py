@@ -56,6 +56,21 @@ def fftfreq(n):
 pfftfreq = fftfreq
 
 
+def get_sliced_momenta(grid_shape, dtype, local_slice=None):
+    """Per-slice FFT mode numbers (reference ``get_sliced_momenta``,
+    /root/reference/pystella/fourier/dft.py:335-349). With a single
+    controller and global sharded arrays the "local slice" is the whole
+    k-space axis set; pass ``local_slice`` (a tuple of slices) to subset."""
+    rdtype = get_real_dtype_with_matching_prec(dtype)
+    k = [fftfreq(n).astype(rdtype) for n in grid_shape]
+    if np.dtype(dtype).kind == "f":
+        n = grid_shape[-1]
+        k[-1] = np.fft.rfftfreq(n, 1 / n).astype(rdtype)
+    if local_slice is not None:
+        k = [ki[sl] for ki, sl in zip(k, local_slice)]
+    return k
+
+
 def make_hermitian(fk):
     """Impose the Hermitian symmetry a real field's Fourier modes satisfy on
     the r2c-layout array ``fk`` (shape ``(Nx, Ny, Nz//2+1)``): on the

@@ -148,9 +148,12 @@ class FiniteDifferencer:
         self.first = first_stencil_factory(self.h)
         self.second = stencil_factory(self.h)
         if mode == "auto":
+            # pallas only on TPU (Mosaic is TPU-only; on CPU it would run
+            # in slow interpret mode — tests opt in explicitly)
             py, pz = decomp.proc_shape[1], decomp.proc_shape[2]
-            mode = "pallas" if (py == 1 and pz == 1
-                               and self.h <= 8) else "halo"
+            mode = "pallas" if (jax.default_backend() == "tpu"
+                                and py == 1 and pz == 1
+                                and self.h <= 8) else "halo"
         if mode not in ("halo", "roll", "pallas"):
             raise ValueError(f"unknown mode {mode}")
         if mode == "pallas" and (decomp.proc_shape[1] != 1

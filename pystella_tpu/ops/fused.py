@@ -46,9 +46,9 @@ class FusedScalarStepper(_step.Stepper):
     """One-kernel-per-stage low-storage RK for a :class:`ScalarSector`.
 
     :arg sector: a :class:`~pystella_tpu.ScalarSector`.
-    :arg decomp: :class:`~pystella_tpu.DomainDecomposition` (single-shard
-        lattice axes for now; the sharded path pads x and uses
-        ``x_halo=True``).
+    :arg decomp: :class:`~pystella_tpu.DomainDecomposition`; must be
+        unsharded (``proc_shape (1,1,1)``) — multi-chip meshes use the
+        generic steppers until the fused sharded path lands.
     :arg grid_shape: local lattice shape.
     :arg dx: lattice spacing (scalar or 3-tuple).
     :arg halo_shape: stencil radius ``h``.
@@ -68,6 +68,11 @@ class FusedScalarStepper(_step.Stepper):
         self.dt = dt
         self.sector = sector
         self.decomp = decomp
+        if tuple(decomp.proc_shape) != (1, 1, 1):
+            raise NotImplementedError(
+                "fused steppers currently require an unsharded lattice "
+                "(proc_shape (1,1,1)); use the generic LowStorageRK steppers "
+                "with FiniteDifferencer for multi-chip meshes")
         self.grid_shape = tuple(grid_shape)
         if np.isscalar(dx):
             dx = (dx,) * 3

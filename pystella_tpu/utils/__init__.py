@@ -1,4 +1,5 @@
+from pystella_tpu.utils.checkpoint import Checkpointer
 from pystella_tpu.utils.output import OutputFile
 from pystella_tpu.utils.profiling import timer
 
-__all__ = ["OutputFile", "timer"]
+__all__ = ["Checkpointer", "OutputFile", "timer"]

@@ -1,0 +1,151 @@
+"""Checkpoint / resume of simulation state.
+
+The reference has **no resume path** — its only persistence is the
+append-only HDF5 time series of derived quantities
+(/root/reference/pystella/output.py:52-181; field snapshots are never
+written, and an interrupted run restarts from scratch). On TPU, long
+multi-chip runs make restart-from-scratch untenable, so checkpointing is a
+first-class subsystem here: sharded field arrays are written directly from
+device memory via orbax (each host writing its own shards — no gather), and
+restore places them back onto the same (or a compatible) mesh.
+
+The checkpoint state is any pytree: typically ``{"f": ..., "dfdt": ...}``
+plus host-side scalars (time, scale factor, step count) passed as
+``metadata``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["Checkpointer"]
+
+
+class Checkpointer:
+    """Simulation checkpoint manager (orbax-backed).
+
+    :arg directory: checkpoint root; created if absent.
+    :arg max_to_keep: retain only the newest N checkpoints (default 3).
+    :arg save_interval_steps: ``maybe_save`` saves only every N steps.
+
+    Usage::
+
+        ckpt = Checkpointer("ckpts", max_to_keep=2)
+        ckpt.save(step, state, metadata={"t": t, "a": float(a)})
+        ...
+        step, state, meta = ckpt.restore(sharding_fn=decomp.shard)
+    """
+
+    def __init__(self, directory, max_to_keep=3, save_interval_steps=1):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(str(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps)
+        self._mngr = ocp.CheckpointManager(self.directory, options=options)
+
+    # -- writing -----------------------------------------------------------
+
+    def save(self, step, state, metadata=None, force=True):
+        """Write ``state`` (pytree of arrays) at ``step``. ``metadata`` is a
+        JSON-serializable dict (time, scale factor, rng keys as lists...).
+        An explicit ``save`` always writes (``force=True``), ignoring
+        ``save_interval_steps`` — use :meth:`maybe_save` for the throttled
+        in-loop call. Returns True if a save was performed."""
+        ocp = self._ocp
+        args = {"state": ocp.args.StandardSave(state)}
+        if metadata is not None:
+            args["meta"] = ocp.args.JsonSave(_jsonify(metadata))
+        saved = self._mngr.save(int(step), args=ocp.args.Composite(**args),
+                                force=force)
+        return bool(saved)
+
+    def maybe_save(self, step, state, metadata=None):
+        """Save only when ``step`` matches ``save_interval_steps``."""
+        return self.save(step, state, metadata, force=False)
+
+    def wait(self):
+        """Block until async writes are durable."""
+        self._mngr.wait_until_finished()
+
+    # -- reading -----------------------------------------------------------
+
+    @property
+    def latest_step(self):
+        return self._mngr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mngr.all_steps())
+
+    def restore(self, step=None, template=None, sharding_fn=None):
+        """Restore ``(step, state, metadata)``.
+
+        :arg step: which checkpoint (default: newest).
+        :arg template: optional pytree of abstract arrays
+            (``jax.ShapeDtypeStruct`` with shardings) controlling placement;
+            when given, arrays are restored directly onto its shardings.
+        :arg sharding_fn: convenience alternative — a callable applied to
+            each restored (host) array, e.g. ``decomp.shard``.
+        """
+        ocp = self._ocp
+        step = step if step is not None else self.latest_step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+
+        args = {}
+        if template is not None:
+            args["state"] = ocp.args.StandardRestore(template)
+        else:
+            args["state"] = ocp.args.StandardRestore()
+        # probe item presence up front instead of retrying the (large)
+        # state restore when metadata is absent
+        try:
+            has_meta = "meta" in (self._mngr.item_metadata(int(step))
+                                  or {})
+        except Exception:
+            has_meta = False
+        if has_meta:
+            restored = self._mngr.restore(
+                int(step),
+                args=ocp.args.Composite(
+                    **args, meta=ocp.args.JsonRestore()))
+            meta = restored.get("meta")
+        else:
+            restored = self._mngr.restore(
+                int(step), args=ocp.args.Composite(**args))
+            meta = None
+        state = restored["state"]
+        if sharding_fn is not None:
+            import jax
+            state = jax.tree_util.tree_map(sharding_fn, state)
+        return int(step), state, meta
+
+    def close(self):
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _jsonify(obj):
+    """Make numpy/jax scalars JSON-safe."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()
+    return obj

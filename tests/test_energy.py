@@ -1,0 +1,101 @@
+"""Energy-reduction tests vs direct computation (reference
+/root/reference/test/test_energy.py: ScalarSector energy components compared
+against hand-computed sums over the lattice)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import pystella_tpu as ps
+
+
+@pytest.fixture(params=[(1, 1, 1), (2, 2, 1)])
+def decomp(request):
+    n = int(np.prod(request.param))
+    return ps.DomainDecomposition(request.param, devices=jax.devices()[:n])
+
+
+def _potential(f):
+    return 0.3 * f[0] ** 2 + 0.05 * f[0] ** 2 * f[1] ** 2
+
+
+def test_scalar_energy_vs_direct(decomp, grid_shape):
+    nscalars = 2
+    a = 1.7
+    rng = np.random.default_rng(21)
+    f = rng.standard_normal((nscalars,) + grid_shape)
+    dfdt = rng.standard_normal((nscalars,) + grid_shape)
+
+    lattice = ps.Lattice(grid_shape, (2 * np.pi,) * 3, dtype=np.float64)
+    fd = ps.FiniteDifferencer(decomp, 2, lattice.dx, mode="halo")
+    sector = ps.ScalarSector(nscalars, potential=_potential)
+    reducer = ps.Reduction(decomp, sector,
+                           grid_size=float(np.prod(grid_shape)))
+
+    fdev = decomp.shard(jnp.asarray(f))
+    lap_f = fd.lap(fdev)
+    energy = reducer(f=fdev, dfdt=decomp.shard(jnp.asarray(dfdt)),
+                     lap_f=lap_f, a=a)
+
+    # direct computation
+    kin = np.mean(dfdt ** 2, axis=(1, 2, 3)) / 2 / a ** 2
+    pot = np.mean(0.3 * f[0] ** 2 + 0.05 * f[0] ** 2 * f[1] ** 2)
+    lap_np = np.asarray(lap_f)
+    grad = np.mean(-f * lap_np, axis=(1, 2, 3)) / 2 / a ** 2
+
+    assert np.allclose(energy["kinetic"], kin, rtol=1e-12)
+    assert np.allclose(energy["potential"], pot, rtol=1e-12)
+    assert np.allclose(energy["gradient"], grad, rtol=1e-12)
+
+
+def test_gradient_energy_integration_by_parts(decomp, grid_shape):
+    """On a periodic lattice sum(|grad f|^2) == -sum(f lap f) when grad/lap
+    use consistent stencils... they don't exactly (different eigenvalues),
+    but they must agree to truncation order for smooth fields (the physics
+    consistency the reference leans on, sectors.py:133-144)."""
+    lattice = ps.Lattice(grid_shape, (2 * np.pi,) * 3, dtype=np.float64)
+    fd = ps.FiniteDifferencer(decomp, 2, lattice.dx, mode="halo")
+
+    kvec = (1, 2, 0)
+    xs = [np.arange(n) * d for n, d in zip(grid_shape, lattice.dx)]
+    X, Y, Z = np.meshgrid(*xs, indexing="ij")
+    f = np.sin(kvec[0] * X + kvec[1] * Y + kvec[2] * Z)
+
+    fdev = decomp.shard(jnp.asarray(f))
+    lap = np.asarray(fd.lap(fdev))
+    grad = np.asarray(fd.grad(fdev))
+
+    lhs = np.sum(grad ** 2)
+    rhs = -np.sum(f * lap)
+    # the two forms differ exactly by the first- vs second-derivative
+    # stencil eigenvalues (reference derivs.py:127-191)
+    eff_k2 = sum(ps.FirstCenteredDifference(2).get_eigenvalues(
+        k, d) ** 2 for k, d in zip(kvec, lattice.dx))
+    eig2 = -sum(ps.SecondCenteredDifference(2).get_eigenvalues(
+        k, d) for k, d in zip(kvec, lattice.dx))
+    assert abs(lhs / rhs - eff_k2 / eig2) < 1e-10
+
+
+def test_get_rho_and_p_consistency(decomp, grid_shape):
+    rng = np.random.default_rng(23)
+    f = rng.standard_normal((1,) + grid_shape)
+    dfdt = rng.standard_normal((1,) + grid_shape)
+
+    lattice = ps.Lattice(grid_shape, (2 * np.pi,) * 3, dtype=np.float64)
+    fd = ps.FiniteDifferencer(decomp, 1, lattice.dx, mode="halo")
+    sector = ps.ScalarSector(1, potential=lambda x: 0.5 * x[0] ** 2)
+    reducer = ps.Reduction(decomp, sector, callback=ps.get_rho_and_p,
+                           grid_size=float(np.prod(grid_shape)))
+
+    fdev = decomp.shard(jnp.asarray(f))
+    energy = reducer(f=fdev, dfdt=decomp.shard(jnp.asarray(dfdt)),
+                     lap_f=fd.lap(fdev), a=1.0)
+    total = (np.sum(energy["kinetic"]) + np.sum(energy["potential"])
+             + np.sum(energy["gradient"]))
+    assert np.allclose(energy["total"], total, rtol=1e-12)
+    pressure = (np.sum(energy["kinetic"])
+                - np.sum(energy["gradient"]) / 3
+                - np.sum(energy["potential"]))
+    assert np.allclose(energy["pressure"], pressure, rtol=1e-12)

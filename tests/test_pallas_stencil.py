@@ -138,7 +138,7 @@ def test_finitedifferencer_auto_fallback_odd_grid():
     import pystella_tpu as ps
 
     decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
-    fd = ps.FiniteDifferencer(decomp, 2, 0.3)
+    fd = ps.FiniteDifferencer(decomp, 2, 0.3, mode="pallas")
     for n in (12, 4):
         rng = np.random.default_rng(0)
         x = jnp.asarray(rng.standard_normal((n, n, n)))
