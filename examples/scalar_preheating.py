@@ -37,6 +37,13 @@ parser.add_argument("--end-scale-factor", "-end-a", type=float, default=20)
 parser.add_argument("--gravitational-waves", "-gws", action="store_true")
 parser.add_argument("--outfile", type=str, default=None)
 parser.add_argument("--seed", type=int, default=49279)
+parser.add_argument("--fused", action="store_true",
+                    help="use the fused Pallas RK stages (requires y/z "
+                         "unsharded and halo-shape >= 1)")
+parser.add_argument("--checkpoint-dir", type=str, default=None,
+                    help="enable checkpoint/resume under this directory")
+parser.add_argument("--checkpoint-interval", type=int, default=100,
+                    metavar="STEPS")
 
 
 def main(argv=None):
@@ -91,7 +98,21 @@ def main(argv=None):
             aux["lap_hij"] = derivs.lap(state["hij"])
         return sector_rhs(state, t, **aux)
 
-    stepper = Stepper(full_rhs, dt=dt)
+    if p.fused and p.halo_shape == 0:
+        raise ValueError("--fused requires finite differences "
+                         "(--halo-shape >= 1), not spectral derivatives")
+    if p.fused:
+        if p.gravitational_waves:
+            stepper = ps.FusedPreheatStepper(
+                scalar_sector, gw_sector, decomp, p.grid_shape,
+                lattice.dx, p.halo_shape, tableau=Stepper,
+                dtype=p.dtype, dt=dt)
+        else:
+            stepper = ps.FusedScalarStepper(
+                scalar_sector, decomp, p.grid_shape, lattice.dx,
+                p.halo_shape, tableau=Stepper, dtype=p.dtype, dt=dt)
+    else:
+        stepper = Stepper(full_rhs, dt=dt)
 
     reduce_energy = ps.Reduction(decomp, scalar_sector,
                                  callback=ps.get_rho_and_p,
@@ -197,6 +218,22 @@ def main(argv=None):
     expand = ps.Expansion(energy["total"], Stepper, mpl=p.mpl)
 
     t, step_count = 0., 0
+
+    ckpt = None
+    if p.checkpoint_dir is not None:
+        ckpt = ps.Checkpointer(p.checkpoint_dir,
+                               save_interval_steps=p.checkpoint_interval)
+        if ckpt.latest_step is not None:
+            step_count, state, meta = ckpt.restore(sharding_fn=decomp.shard)
+            t = meta["t"]
+            expand = ps.Expansion(meta["energy_total"], Stepper, mpl=p.mpl)
+            expand.a = expand.dtype.type(meta["a"])
+            expand.adot = expand.dtype.type(meta["adot"])
+            expand.hubble = expand.adot / expand.a
+            energy = compute_energy(state, expand.a)
+            if decomp.rank == 0:
+                print(f"Resumed from checkpoint at step {step_count}")
+
     output(step_count, t, energy, expand, state)
 
     if decomp.rank == 0:
@@ -224,11 +261,23 @@ def main(argv=None):
         t += dt
         step_count += 1
         output(step_count, t, energy, expand, state)
+        if ckpt is not None:
+            ckpt.maybe_save(step_count, state, metadata={
+                "t": t, "a": float(expand.a), "adot": float(expand.adot),
+                "energy_total": float(np.sum(energy["total"]))})
         if time() - last_out > 30 and decomp.rank == 0:
             last_out = time()
             ms_per_step = (last_out - start) * 1e3 / step_count
             print(f"{t:<15.3f}", f"{expand.a:<15.3f}",
                   f"{ms_per_step:<15.3f}", f"{1e3 / ms_per_step:<15.3f}")
+
+    if ckpt is not None:
+        if ckpt.latest_step != step_count:  # orbax forbids re-saving a step
+            ckpt.save(step_count, state, metadata={
+                "t": t, "a": float(expand.a), "adot": float(expand.adot),
+                "energy_total": float(np.sum(energy["total"]))})
+        ckpt.wait()
+        ckpt.close()
 
     constraint = expand.constraint(energy["total"])
     if decomp.rank == 0:

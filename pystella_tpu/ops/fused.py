@@ -46,10 +46,13 @@ class FusedScalarStepper(_step.Stepper):
     """One-kernel-per-stage low-storage RK for a :class:`ScalarSector`.
 
     :arg sector: a :class:`~pystella_tpu.ScalarSector`.
-    :arg decomp: :class:`~pystella_tpu.DomainDecomposition`; must be
-        unsharded (``proc_shape (1,1,1)``) — multi-chip meshes use the
-        generic steppers until the fused sharded path lands.
-    :arg grid_shape: local lattice shape.
+    :arg decomp: :class:`~pystella_tpu.DomainDecomposition`; the lattice
+        may be sharded along x (``proc_shape (px, 1, 1)``) — each device
+        pads its x-block with ``lax.ppermute`` halos and runs the fused
+        kernel on its local block inside ``shard_map``. For y/z-sharded
+        meshes use the generic steppers.
+    :arg grid_shape: the *global* lattice shape (divided over the mesh's
+        x axis when sharded).
     :arg dx: lattice spacing (scalar or 3-tuple).
     :arg halo_shape: stencil radius ``h``.
     :arg tableau: a :class:`~pystella_tpu.LowStorageRKStepper` subclass
@@ -68,17 +71,18 @@ class FusedScalarStepper(_step.Stepper):
         self.dt = dt
         self.sector = sector
         self.decomp = decomp
-        if tuple(decomp.proc_shape) != (1, 1, 1):
+        if decomp.proc_shape[1] != 1 or decomp.proc_shape[2] != 1:
             raise NotImplementedError(
-                "fused steppers currently require an unsharded lattice "
-                "(proc_shape (1,1,1)); use the generic LowStorageRK steppers "
-                "with FiniteDifferencer for multi-chip meshes")
+                "fused steppers support sharding only along x "
+                "(proc_shape (px, 1, 1)); use the generic LowStorageRK "
+                "steppers with FiniteDifferencer for y/z-sharded meshes")
+        self._px = decomp.proc_shape[0]
         self.grid_shape = tuple(grid_shape)
         if np.isscalar(dx):
             dx = (dx,) * 3
         self.dx = tuple(float(d) for d in dx)
         self.h = int(halo_shape)
-        self.dtype = jnp.dtype(dtype)
+        self.dtype = jnp.zeros((), dtype).dtype
 
         F = sector.nscalars
         self.F = F
@@ -86,17 +90,66 @@ class FusedScalarStepper(_step.Stepper):
         V = sector.potential(f)
         self._dvdf = [_field.diff(V, f[i]) for i in range(F)]
 
+        self.local_shape = decomp.rank_shape(self.grid_shape)
         self._scalar_st = StreamingStencil(
-            self.grid_shape, {"f": F}, self.h,
+            self.local_shape, {"f": F}, self.h,
             self._scalar_body, out_defs={
                 "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
             extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
             scalar_names=("dt", "a", "hubble", "A", "B"),
-            dtype=self.dtype, bx=bx, by=by)
+            dtype=self.dtype, bx=bx, by=by, x_halo=(self._px > 1))
+        self._scalar_call = self._make_call(
+            self._scalar_st, windows=("f",),
+            extra_names=("dfdt", "kf", "kdfdt"))
 
         # jitted whole-step (one XLA computation, all stages fused)
         import jax
         self._jit_step = jax.jit(self._step_impl)
+
+    def _make_call(self, st, windows, extra_names):
+        """Wrap a StreamingStencil in the sharded-x ``shard_map`` (padding
+        the windowed inputs with ``ppermute`` halos) or call it directly on
+        an unsharded lattice."""
+        if self._px == 1:
+            def call(win_arrays, scalars, extras):
+                arg = (win_arrays[windows[0]] if len(windows) == 1
+                       else win_arrays)
+                return st(arg, scalars=scalars, extras=extras)
+            return call
+
+        import jax
+        decomp = self.decomp
+        h = self.h
+        out_names = list(st.out_defs)
+        scalar_names = st.scalar_names
+        from jax.sharding import PartitionSpec as P
+
+        def body(*flat):
+            nw = len(windows)
+            wins = {n: decomp.pad_with_halos(a, (h, 0, 0))
+                    for n, a in zip(windows, flat[:nw])}
+            ns = len(scalar_names)
+            scalars = dict(zip(scalar_names, flat[nw:nw + ns]))
+            extras = dict(zip(extra_names, flat[nw + ns:]))
+            arg = wins[windows[0]] if nw == 1 else wins
+            outs = st(arg, scalars=scalars, extras=extras)
+            return tuple(outs[n] for n in out_names)
+
+        lat_spec = decomp.spec(1)
+        in_specs = ((lat_spec,) * len(windows) + (P(),) * len(scalar_names)
+                    + (lat_spec,) * len(extra_names))
+        out_specs = tuple(decomp.spec(1) for _ in out_names)
+        sharded = jax.jit(decomp.shard_map(
+            body, in_specs, out_specs, check_vma=False))
+
+        def call(win_arrays, scalars, extras):
+            flat = ([win_arrays[n] for n in windows]
+                    + [jnp.asarray(scalars[n], st.dtype).reshape(())
+                       for n in scalar_names]
+                    + [extras[n] for n in extra_names])
+            res = sharded(*flat)
+            return dict(zip(out_names, res))
+        return call
 
     # -- kernel body -------------------------------------------------------
 
@@ -146,11 +199,10 @@ class FusedScalarStepper(_step.Stepper):
 
     def stage(self, s, carry, t, dt, rhs_args):
         state, k = carry
-        outs = self._scalar_st(
-            state["f"],
-            scalars=self._stage_scalars(s, dt, rhs_args),
-            extras={"dfdt": state["dfdt"], "kf": k["f"],
-                    "kdfdt": k["dfdt"]})
+        outs = self._scalar_call(
+            {"f": state["f"]},
+            self._stage_scalars(s, dt, rhs_args),
+            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"]})
         return ({"f": outs["f"], "dfdt": outs["dfdt"]},
                 {"f": outs["kf"], "dfdt": outs["kdfdt"]})
 
@@ -197,14 +249,17 @@ class FusedPreheatStepper(FusedScalarStepper):
                     for sec in gw_sector.sectors)
 
         self._tensor_st = StreamingStencil(
-            self.grid_shape, {"f": self.F, "hij": self.n_hij}, self.h,
+            self.local_shape, {"f": self.F, "hij": self.n_hij}, self.h,
             self._tensor_body, out_defs={
                 "hij": (self.n_hij,), "dhijdt": (self.n_hij,),
                 "khij": (self.n_hij,), "kdhijdt": (self.n_hij,)},
             extra_defs={"dhijdt": (self.n_hij,), "khij": (self.n_hij,),
                         "kdhijdt": (self.n_hij,)},
             scalar_names=("dt", "a", "hubble", "A", "B"),
-            dtype=self.dtype, bx=bx, by=by)
+            dtype=self.dtype, bx=bx, by=by, x_halo=(self._px > 1))
+        self._tensor_call = self._make_call(
+            self._tensor_st, windows=("f", "hij"),
+            extra_names=("dhijdt", "khij", "kdhijdt"))
 
         import jax
         self._jit_step = jax.jit(self._step_impl)
@@ -243,14 +298,13 @@ class FusedPreheatStepper(FusedScalarStepper):
     def stage(self, s, carry, t, dt, rhs_args):
         state, k = carry
         scalars = self._stage_scalars(s, dt, rhs_args)
-        souts = self._scalar_st(
-            state["f"], scalars=scalars,
-            extras={"dfdt": state["dfdt"], "kf": k["f"],
-                    "kdfdt": k["dfdt"]})
-        touts = self._tensor_st(
-            {"f": state["f"], "hij": state["hij"]}, scalars=scalars,
-            extras={"dhijdt": state["dhijdt"], "khij": k["hij"],
-                    "kdhijdt": k["dhijdt"]})
+        souts = self._scalar_call(
+            {"f": state["f"]}, scalars,
+            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"]})
+        touts = self._tensor_call(
+            {"f": state["f"], "hij": state["hij"]}, scalars,
+            {"dhijdt": state["dhijdt"], "khij": k["hij"],
+             "kdhijdt": k["dhijdt"]})
         new_state = {"f": souts["f"], "dfdt": souts["dfdt"],
                      "hij": touts["hij"], "dhijdt": touts["dhijdt"]}
         new_k = {"f": souts["kf"], "dfdt": souts["kdfdt"],

@@ -182,7 +182,9 @@ class StreamingStencil:
         self.extra_defs = {k: tuple(v)
                            for k, v in dict(extra_defs or {}).items()}
         self.scalar_names = tuple(scalar_names)
-        self.dtype = jnp.dtype(dtype)
+        # canonicalize (f64 -> f32 when x64 is disabled) so out_shapes and
+        # in-kernel values agree
+        self.dtype = jnp.zeros((), dtype).dtype
         if bx is None or by is None:
             cbx, cby = choose_blocks(
                 sum(self.win_defs.values()), self.lattice_shape, self.h,

@@ -54,9 +54,15 @@ def make_mesh(proc_shape=None, axis_names=("x", "y", "z"), devices=None):
             f"proc_shape {proc_shape} does not cover {len(devices)} devices")
     mesh_devices = np.asarray(devices).reshape(proc_shape)
     # Explicit axis types: required by the declarative pencil-FFT reshards
-    # (jax.sharding.reshard refuses Auto axes)
+    # (jax.sharding.reshard refuses Auto axes). On a single-device mesh
+    # nothing is ever resharded and explicit-sharding type tracking only
+    # gets in the way (e.g. of pallas_call), so use Auto there.
+    if len(devices) == 1:
+        axis_types = (AxisType.Auto,) * len(proc_shape)
+    else:
+        axis_types = (AxisType.Explicit,) * len(proc_shape)
     return Mesh(mesh_devices, axis_names[:len(proc_shape)],
-                axis_types=(AxisType.Explicit,) * len(proc_shape))
+                axis_types=axis_types)
 
 
 class DomainDecomposition:

@@ -115,3 +115,66 @@ def test_fused_preheat_matches_generic(decomp):
         err = np.max(np.abs(np.asarray(got[name]) - np.asarray(ref[name])))
         scale = max(np.max(np.abs(np.asarray(ref[name]))), 1e-30)
         assert err / scale < 1e-11, (name, err, scale)
+
+
+@pytest.mark.parametrize("px", [2, 4])
+def test_fused_scalar_sharded_x_matches_single(px):
+    """x-sharded fused stages agree with the single-device fused path."""
+    if len(jax.devices()) < px:
+        pytest.skip(f"needs {px} devices")
+    grid_shape = (16, 16, 16)
+    h, dx, dt = 2, 0.3, 0.01
+    rng = np.random.default_rng(8)
+    state_h = {
+        "f": rng.standard_normal((2,) + grid_shape),
+        "dfdt": 0.1 * rng.standard_normal((2,) + grid_shape),
+    }
+    sector = ps.ScalarSector(2, potential=_potential)
+
+    d1 = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+    f1 = FusedScalarStepper(sector, d1, grid_shape, dx, h,
+                            dtype=jnp.float64, bx=4, by=8)
+    ref = f1.step({k: jnp.asarray(v) for k, v in state_h.items()},
+                  0.0, dt, {"a": 1.2, "hubble": 0.3})
+
+    dp = ps.DomainDecomposition((px, 1, 1), devices=jax.devices()[:px])
+    fp = FusedScalarStepper(sector, dp, grid_shape, dx, h,
+                            dtype=jnp.float64, bx=4, by=8)
+    got = fp.step({k: dp.shard(v) for k, v in state_h.items()},
+                  0.0, dt, {"a": 1.2, "hubble": 0.3})
+
+    for name in ("f", "dfdt"):
+        assert np.allclose(np.asarray(got[name]), np.asarray(ref[name]),
+                           rtol=1e-13, atol=1e-13), name
+
+
+def test_fused_preheat_sharded_x_matches_single():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    grid_shape = (16, 16, 16)
+    h, dx, dt = 2, 0.3, 0.01
+    rng = np.random.default_rng(9)
+    state_h = {
+        "f": rng.standard_normal((2,) + grid_shape),
+        "dfdt": 0.1 * rng.standard_normal((2,) + grid_shape),
+        "hij": 1e-3 * rng.standard_normal((6,) + grid_shape),
+        "dhijdt": 1e-4 * rng.standard_normal((6,) + grid_shape),
+    }
+    sector = ps.ScalarSector(2, potential=_potential)
+    gw = ps.TensorPerturbationSector([sector])
+
+    d1 = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+    f1 = FusedPreheatStepper(sector, gw, d1, grid_shape, dx, h,
+                             dtype=jnp.float64, bx=4, by=8)
+    ref = f1.step({k: jnp.asarray(v) for k, v in state_h.items()},
+                  0.0, dt, {"a": 1.1, "hubble": 0.2})
+
+    dp = ps.DomainDecomposition((2, 1, 1), devices=jax.devices()[:2])
+    fp = FusedPreheatStepper(sector, gw, dp, grid_shape, dx, h,
+                             dtype=jnp.float64, bx=4, by=8)
+    got = fp.step({k: dp.shard(v) for k, v in state_h.items()},
+                  0.0, dt, {"a": 1.1, "hubble": 0.2})
+
+    for name in state_h:
+        assert np.allclose(np.asarray(got[name]), np.asarray(ref[name]),
+                           rtol=1e-12, atol=1e-13), name
