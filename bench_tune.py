@@ -1,0 +1,104 @@
+"""Block-size tuning sweep for the fused Pallas RK stage (run on real TPU).
+
+Sweeps (bx, by) for FusedScalarStepper at the benchmark grids and prints a
+ranked table; the winners become the ``choose_blocks`` defaults in
+``pystella_tpu/ops/pallas_stencil.py``. Also compares the fused path
+against the unfused (XLA) path.
+
+Usage: ``python bench_tune.py [--grid 256] [--steps 10]``
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def sync(x):
+    import jax.numpy as jnp
+    return float(jnp.sum(jnp.ravel(x)[:8]))
+
+
+def run_config(grid_shape, bx, by, nsteps=10, dtype=np.float32):
+    import jax
+    import pystella_tpu as ps
+
+    lattice = ps.Lattice(grid_shape, (5.0, 5.0, 5.0), dtype=dtype)
+    dt = dtype(0.1 * min(lattice.dx))
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+
+    mphi, gsq = 1.20e-6, 2.5e-7
+
+    def potential(f):
+        return (mphi**2 / 2 * f[0]**2 + gsq / 2 * f[0]**2 * f[1]**2) / mphi**2
+
+    sector = ps.ScalarSector(2, potential=potential)
+    stepper = ps.FusedScalarStepper(sector, decomp, grid_shape, lattice.dx,
+                                    2, dtype=dtype, bx=bx, by=by)
+
+    def one_step(state, t, dt, a, hubble):
+        carry = stepper.init_carry(state)
+        for s in range(stepper.num_stages):
+            carry = stepper.stage(s, carry, t, dt, {"a": a, "hubble": hubble})
+        return stepper.extract(carry)
+
+    step = jax.jit(one_step, donate_argnums=0)
+    rng = np.random.default_rng(7)
+    state = {
+        "f": decomp.shard(
+            0.1 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
+        "dfdt": decomp.shard(
+            0.01 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
+    }
+    t0, a, hub = dtype(0), dtype(1), dtype(0.5)
+    for _ in range(2):
+        state = step(state, t0, dt, a, hub)
+    sync(state["f"])
+    start = time.perf_counter()
+    for _ in range(nsteps):
+        state = step(state, t0, dt, a, hub)
+    sync(state["f"])
+    elapsed = (time.perf_counter() - start) / nsteps
+    return float(np.prod(grid_shape)) / elapsed, elapsed
+
+
+def main():
+    n = 256
+    nsteps = 10
+    if "--grid" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--grid") + 1])
+    if "--steps" in sys.argv:
+        nsteps = int(sys.argv[sys.argv.index("--steps") + 1])
+    grid_shape = (n, n, n)
+
+    configs = []
+    for by in (256, 128, 64):
+        if by > n or n % by:
+            continue
+        for bx in (1, 2, 4, 8):
+            if n % bx or bx < 2:
+                if bx < 2:
+                    continue
+                continue
+            configs.append((bx, by))
+
+    results = []
+    for bx, by in configs:
+        try:
+            ups, s_per = run_config(grid_shape, bx, by, nsteps)
+            results.append((ups, bx, by, s_per))
+            print(f"bx={bx:3d} by={by:4d}: {s_per*1e3:8.2f} ms/step  "
+                  f"{ups:.3e} site-updates/s", flush=True)
+        except Exception as e:
+            print(f"bx={bx:3d} by={by:4d}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:100]}", flush=True)
+
+    if results:
+        results.sort(reverse=True)
+        ups, bx, by, s_per = results[0]
+        print(f"\nBEST: bx={bx} by={by} -> {ups:.3e} site-updates/s "
+              f"({ups/1e9:.2f}x of 1e9 target)")
+
+
+if __name__ == "__main__":
+    main()

@@ -241,51 +241,66 @@ def main(argv=None):
         print("time\t", "scale factor", "ms/step\t", "steps/second",
               sep="\t")
 
-    from time import time
-    start = time()
-    last_out = time()
+    steptimer = ps.StepTimer(report_every=30.0)
+    # check at least as often as checkpoints are written so a diverged
+    # state is never saved
+    monitor = ps.HealthMonitor(
+        every=min(50, p.checkpoint_interval) if p.checkpoint_dir else 50)
 
     carry = None
-    while t < p.end_time and expand.a < p.end_scale_factor:
-        for s in range(stepper.num_stages):
-            carry = stepper(s, state if s == 0 else carry, t,
-                            a=np.float64(expand.a),
-                            hubble=np.float64(expand.hubble))
-            expand.step(s, energy["total"], energy["pressure"], dt)
-            if s == stepper.num_stages - 1:
-                state = carry
-                energy = compute_energy(state, expand.a)
-            else:
-                energy = compute_energy(stepper.current(carry), expand.a)
+    try:
+        while t < p.end_time and expand.a < p.end_scale_factor:
+            for s in range(stepper.num_stages):
+                carry = stepper(s, state if s == 0 else carry, t,
+                                a=np.float64(expand.a),
+                                hubble=np.float64(expand.hubble))
+                expand.step(s, energy["total"], energy["pressure"], dt)
+                if s == stepper.num_stages - 1:
+                    state = carry
+                    energy = compute_energy(state, expand.a)
+                else:
+                    energy = compute_energy(stepper.current(carry), expand.a)
 
-        t += dt
-        step_count += 1
-        output(step_count, t, energy, expand, state)
-        if ckpt is not None:
-            ckpt.maybe_save(step_count, state, metadata={
-                "t": t, "a": float(expand.a), "adot": float(expand.adot),
-                "energy_total": float(np.sum(energy["total"]))})
-        if time() - last_out > 30 and decomp.rank == 0:
-            last_out = time()
-            ms_per_step = (last_out - start) * 1e3 / step_count
-            print(f"{t:<15.3f}", f"{expand.a:<15.3f}",
-                  f"{ms_per_step:<15.3f}", f"{1e3 / ms_per_step:<15.3f}")
+            t += dt
+            step_count += 1
+            output(step_count, t, energy, expand, state)
+            # gate saves on a same-step health check so a NaN state is
+            # never checkpointed (orbax writes the very first save
+            # regardless of save_interval_steps)
+            checked = monitor(step_count, state)
+            if ckpt is not None and checked:
+                ckpt.maybe_save(step_count, state, metadata={
+                    "t": t, "a": float(expand.a),
+                    "adot": float(expand.adot),
+                    "energy_total": float(np.sum(energy["total"]))})
+            telemetry = steptimer.tick()
+            if telemetry is not None and decomp.rank == 0:
+                ms_per_step, steps_per_s = telemetry
+                print(f"{t:<15.3f}", f"{expand.a:<15.3f}",
+                      f"{ms_per_step:<15.3f}", f"{steps_per_s:<15.3f}")
 
-    if ckpt is not None:
-        if ckpt.latest_step != step_count:  # orbax forbids re-saving a step
+        # normal completion (incl. silent NaN-exit from the while
+        # condition): verify health before the final checkpoint
+        monitor(0, state)
+        if ckpt is not None and ckpt.latest_step != step_count:
             ckpt.save(step_count, state, metadata={
                 "t": t, "a": float(expand.a), "adot": float(expand.adot),
                 "energy_total": float(np.sum(energy["total"]))})
-        ckpt.wait()
-        ckpt.close()
+        constraint = expand.constraint(energy["total"])
+        if out is not None:
+            out.file.attrs["final_constraint"] = constraint
+    finally:
+        # finalize persistence even on divergence/interrupt so the last
+        # good checkpoint and the HDF5 series survive
+        if ckpt is not None:
+            ckpt.wait()
+            ckpt.close()
+        if out is not None:
+            out.close()
 
-    constraint = expand.constraint(energy["total"])
     if decomp.rank == 0:
         print("Simulation complete")
         print(f"final constraint: {constraint:.16e}")
-        if out is not None:
-            out.file.attrs["final_constraint"] = constraint
-            out.close()
     return constraint
 
 

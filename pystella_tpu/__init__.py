@@ -39,7 +39,8 @@ from pystella_tpu.models import (
     Sector, ScalarSector, TensorPerturbationSector, tensor_index,
     get_rho_and_p, Expansion,
 )
-from pystella_tpu.utils import Checkpointer, OutputFile, timer
+from pystella_tpu.utils import (Checkpointer, HealthMonitor,
+    SimulationDiverged, OutputFile, StepTimer, timer, trace)
 from pystella_tpu.step import (
     Stepper, RungeKuttaStepper, LowStorageRKStepper, compile_rhs_dict,
     RungeKutta4, RungeKutta3Heun, RungeKutta3Nystrom, RungeKutta3Ralston,
@@ -92,6 +93,7 @@ __all__ = [
     "SpectralCollocator", "SpectralPoissonSolver",
     "Sector", "ScalarSector", "TensorPerturbationSector", "tensor_index",
     "get_rho_and_p", "Expansion", "OutputFile", "timer", "Checkpointer",
+    "HealthMonitor", "SimulationDiverged", "StepTimer", "trace",
     "Stepper", "RungeKuttaStepper", "LowStorageRKStepper", "compile_rhs_dict",
     "RungeKutta4", "RungeKutta3Heun", "RungeKutta3Nystrom",
     "RungeKutta3Ralston", "RungeKutta3SSP", "RungeKutta2Midpoint",

@@ -1,0 +1,76 @@
+"""Runtime health monitoring: NaN/Inf watchdogs for long simulations.
+
+The reference has no failure detection — an instability silently corrupts
+the run until MPI aborts (/root/repo/SURVEY.md section 5, "Failure
+detection: absent"). Here drivers can wrap their loop with a
+:class:`HealthMonitor` that checks the state every N steps (one cheap
+device-side reduction per field, amortized) and raises
+:class:`SimulationDiverged` with the offending field names, so a
+checkpointed run can stop early and resume from the last good snapshot.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["HealthMonitor", "SimulationDiverged"]
+
+
+class SimulationDiverged(RuntimeError):
+    """Raised when non-finite values appear in the simulation state."""
+
+    def __init__(self, step, bad_fields):
+        self.step = step
+        self.bad_fields = tuple(bad_fields)
+        super().__init__(
+            f"non-finite values at step {step} in fields: "
+            f"{', '.join(self.bad_fields)}")
+
+
+class HealthMonitor:
+    """Periodic finite-ness check over a state pytree.
+
+    :arg every: check interval in steps (checks are one ``isfinite`` +
+        ``all`` reduction per array; keep modest to amortize).
+    :arg max_abs: optional magnitude bound — exceeding it also counts as
+        divergence (useful to catch blowup before the first inf).
+    """
+
+    def __init__(self, every=50, max_abs=None):
+        self.every = int(every)
+        self.max_abs = max_abs
+
+        max_abs_ = max_abs
+
+        @jax.jit
+        def check(state):
+            def ok(x):
+                good = jnp.all(jnp.isfinite(x))
+                if max_abs_ is not None:
+                    good = good & (jnp.max(jnp.abs(x)) <= max_abs_)
+                return good
+            return jax.tree_util.tree_map(ok, state)
+
+        self._check = check
+
+    def __call__(self, step, state):
+        """Check (every ``self.every`` steps); raises
+        :class:`SimulationDiverged` on failure, else returns True if the
+        check ran."""
+        if step % self.every:
+            return False
+        flags = self._check(state)
+        leaves = jax.tree_util.tree_flatten_with_path(flags)[0]
+
+        def name(path):
+            return ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+
+        bad = [name(path) for path, v in leaves
+               if not bool(np.asarray(v))]
+        if bad:
+            raise SimulationDiverged(step, bad)
+        return True

@@ -97,7 +97,8 @@ class OutputFile:
             dset[-1] = arr
 
     def close(self):
-        self.file.close()
+        if self.file:  # h5py File is falsy once closed; idempotent
+            self.file.close()
 
     def __enter__(self):
         return self

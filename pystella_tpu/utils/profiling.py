@@ -13,7 +13,7 @@ import time
 
 import jax
 
-__all__ = ["timer"]
+__all__ = ["timer", "trace", "StepTimer"]
 
 
 def timer(kernel, ntime=200, nwarmup=2, reps=1):
@@ -31,3 +31,62 @@ def timer(kernel, ntime=200, nwarmup=2, reps=1):
     jax.block_until_ready(result)
     elapsed = time.perf_counter() - start
     return elapsed / ntime / reps * 1000
+
+
+class trace:
+    """Context manager around ``jax.profiler`` producing a TensorBoard/
+    Perfetto trace of everything inside (kernel timelines, HBM traffic,
+    ICI collectives) — the TPU upgrade over the reference's per-kernel
+    ``pyopencl.Event`` timing (/root/reference/pystella/elementwise.py:
+    322-326).
+
+    Usage::
+
+        with ps.trace("/tmp/trace"):
+            state = stepper.step(state, t, dt, args)
+            jax.block_until_ready(state)
+    """
+
+    def __init__(self, logdir, create_perfetto_link=False):
+        self.logdir = str(logdir)
+        self.create_perfetto_link = create_perfetto_link
+
+    def __enter__(self):
+        jax.profiler.start_trace(
+            self.logdir, create_perfetto_link=self.create_perfetto_link)
+        return self
+
+    def __exit__(self, *exc):
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Rolling ms/step + steps/s telemetry for driver loops (the
+    reference's every-30-seconds console line,
+    /root/reference/examples/scalar_preheating.py:272-276, which reports
+    the lifetime average; here the rate covers only the last reporting
+    window so one-time jit compilation does not skew steady-state
+    numbers).
+
+    Call :meth:`tick` once per step; it returns a ``(ms_per_step,
+    steps_per_s)`` tuple every ``report_every`` seconds and ``None``
+    otherwise.
+    """
+
+    def __init__(self, report_every=30.0):
+        self.report_every = float(report_every)
+        now = time.perf_counter()
+        self.last_report = now
+        self.steps_at_report = 0
+        self.steps = 0
+
+    def tick(self):
+        self.steps += 1
+        now = time.perf_counter()
+        if now - self.last_report < self.report_every:
+            return None
+        window_steps = self.steps - self.steps_at_report
+        ms = (now - self.last_report) * 1e3 / window_steps
+        self.last_report = now
+        self.steps_at_report = self.steps
+        return ms, 1e3 / ms

@@ -1,0 +1,44 @@
+"""Health-monitor tests (new subsystem; reference has no failure
+detection, SURVEY.md section 5)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import pystella_tpu as ps
+
+
+def test_healthy_state_passes():
+    mon = ps.HealthMonitor(every=2)
+    state = {"f": jnp.ones((4, 4, 4)), "dfdt": jnp.zeros((4, 4, 4))}
+    assert mon(0, state) is True
+    assert mon(1, state) is False  # off-interval: skipped
+    assert mon(2, state) is True
+
+
+def test_nan_raises_with_field_name():
+    mon = ps.HealthMonitor(every=1)
+    state = {"f": jnp.ones((4, 4, 4)),
+             "dfdt": jnp.full((4, 4, 4), np.nan)}
+    with pytest.raises(ps.SimulationDiverged) as exc:
+        mon(3, state)
+    assert exc.value.step == 3
+    assert exc.value.bad_fields == ("dfdt",)
+
+
+def test_inf_and_magnitude_bound():
+    mon = ps.HealthMonitor(every=1, max_abs=10.0)
+    with pytest.raises(ps.SimulationDiverged):
+        mon(0, {"f": jnp.full((2, 2, 2), np.inf)})
+    with pytest.raises(ps.SimulationDiverged):
+        mon(0, {"f": jnp.full((2, 2, 2), 100.0)})
+    assert mon(0, {"f": jnp.full((2, 2, 2), 5.0)})
+
+
+def test_step_timer():
+    t = ps.StepTimer(report_every=0.0)
+    out = t.tick()
+    assert out is not None
+    ms, sps = out
+    assert ms > 0 and sps > 0
