@@ -1,3 +1,4 @@
 from pystella_tpu.parallel.decomp import DomainDecomposition, make_mesh
+from pystella_tpu.parallel import multihost
 
-__all__ = ["DomainDecomposition", "make_mesh"]
+__all__ = ["DomainDecomposition", "make_mesh", "multihost"]

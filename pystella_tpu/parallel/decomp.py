@@ -176,6 +176,20 @@ class DomainDecomposition:
     def nranks(self):
         return jax.process_count()
 
+    def rank_tuple(self, rank=None):
+        """Cartesian coordinates of host process ``rank`` in the process
+        grid (reference ``rank_tuple``, decomp.py:298-304). Processes are
+        laid out along the x mesh axis; with one controller this is
+        ``(0, 0, 0)``."""
+        rank = self.rank if rank is None else rank
+        return (rank % max(1, jax.process_count()), 0, 0)
+
+    def rankID(self, *tup):
+        """Flat id of process-grid coordinates with periodic wrap
+        (reference ``rankID``, decomp.py:287-296)."""
+        n = max(1, jax.process_count())
+        return tup[0] % n
+
     # -- halo exchange (shard_map interior) --------------------------------
 
     def _perm(self, axis_name, shift):

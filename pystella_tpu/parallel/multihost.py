@@ -1,0 +1,85 @@
+"""Multi-host (multi-process) runtime helpers.
+
+The reference scales across nodes with `mpirun` + mpi4py — every rank runs
+the same script and `DomainDecomposition` wires the communication
+(/root/reference/pystella/decomp.py:119-127). The TPU-native equivalent is
+JAX multi-controller: one process per host, `jax.distributed.initialize()`
+to form the cluster, and a global `Mesh` spanning every host's devices;
+ICI carries intra-slice collectives and DCN carries cross-slice ones,
+chosen by XLA from the sharding layout.
+
+These helpers keep drivers host-count agnostic: the same script runs
+single-process (tests, one chip) or under a multi-host launcher (GKE,
+`gcloud alpha compute tpus tpu-vm ssh --worker=all`, SLURM) without
+changes, exactly like the reference's graceful single-rank fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = ["init_multihost", "is_initialized", "global_devices",
+           "host_local_to_global", "global_to_host_local", "sync_hosts"]
+
+_initialized = False
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None, **kwargs):
+    """Initialize the multi-controller runtime (idempotent).
+
+    With no arguments JAX auto-detects the cluster environment (TPU pod
+    metadata, SLURM, ...). Single-process runs are a no-op, mirroring the
+    reference's mpi4py-less fallback (decomp.py:119-127).
+    """
+    global _initialized
+    if _initialized:
+        return
+    if num_processes in (None, 1) and coordinator_address is None \
+            and jax.process_count() == 1:
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id, **kwargs)
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized or jax.process_count() > 1
+
+
+def global_devices():
+    """All devices across all hosts (the mesh should be built from these —
+    ``DomainDecomposition(proc_shape, devices=global_devices())``)."""
+    return jax.devices()
+
+
+def host_local_to_global(decomp, host_arrays, outer_axes=0):
+    """Assemble a global sharded array from per-host local blocks
+    (reference ``scatter_array`` across ranks, decomp.py:652-725).
+
+    :arg host_arrays: this host's block (every host passes its own).
+    """
+    from jax.experimental import multihost_utils
+    return multihost_utils.host_local_array_to_global_array(
+        host_arrays, decomp.mesh, decomp.spec(outer_axes))
+
+
+def global_to_host_local(decomp, global_array, outer_axes=0):
+    """This host's local block of a global sharded array (reference
+    ``gather_array`` per-rank view, decomp.py:536-599)."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.global_array_to_host_local_array(
+        global_array, decomp.mesh, decomp.spec(outer_axes))
+
+
+def sync_hosts(name="sync"):
+    """Barrier across hosts (reference ``decomp.Barrier``,
+    decomp.py:351)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
