@@ -12,6 +12,8 @@ from argparse import ArgumentParser
 
 import numpy as np
 
+import jax.numpy as jnp
+
 import pystella_tpu as ps
 
 parser = ArgumentParser()
@@ -205,7 +207,7 @@ def main(argv=None):
     for fld in range(p.nscalars):
         fx, dfx = modes.init_WKB_fields(
             norm=p.mphi**2,
-            omega_k=lambda k, fld=fld: np.sqrt(k**2 + eff_mass[fld]),
+            omega_k=lambda k, fld=fld: jnp.sqrt(k**2 + eff_mass[fld]),
             hubble=expand.hubble)
         fluct_f.append(np.asarray(fx))
         fluct_df.append(np.asarray(dfx))
@@ -244,8 +246,7 @@ def main(argv=None):
     steptimer = ps.StepTimer(report_every=30.0)
     # check at least as often as checkpoints are written so a diverged
     # state is never saved
-    monitor = ps.HealthMonitor(
-        every=min(50, p.checkpoint_interval) if p.checkpoint_dir else 50)
+    monitor = ps.HealthMonitor(every=50)
 
     carry = None
     try:
@@ -264,11 +265,16 @@ def main(argv=None):
             t += dt
             step_count += 1
             output(step_count, t, energy, expand, state)
-            # gate saves on a same-step health check so a NaN state is
-            # never checkpointed (orbax writes the very first save
-            # regardless of save_interval_steps)
+            # a NaN state must never be checkpointed: saves happen exactly
+            # on the requested interval, each preceded by a health check
+            # (the periodic monitor alone would let saves drift to later
+            # steps when the interval isn't a multiple of its cadence)
             checked = monitor(step_count, state)
-            if ckpt is not None and checked:
+            save_due = (ckpt is not None
+                        and step_count % p.checkpoint_interval == 0)
+            if save_due:
+                if not checked:
+                    monitor.check_now(state)
                 ckpt.maybe_save(step_count, state, metadata={
                     "t": t, "a": float(expand.a),
                     "adot": float(expand.adot),
@@ -281,7 +287,7 @@ def main(argv=None):
 
         # normal completion (incl. silent NaN-exit from the while
         # condition): verify health before the final checkpoint
-        monitor(0, state)
+        monitor.check_now(state)
         if ckpt is not None and ckpt.latest_step != step_count:
             ckpt.save(step_count, state, metadata={
                 "t": t, "a": float(expand.a), "adot": float(expand.adot),

@@ -24,7 +24,8 @@ from pystella_tpu.field import (
     Quotient, Sum, Var, _wrap,
 )
 
-__all__ = ["to_sympy", "from_sympy", "simplify", "SympyField"]
+__all__ = ["to_sympy", "from_sympy", "simplify", "SympyField",
+           "reset_field_registry"]
 
 
 def _sympy():
@@ -36,7 +37,21 @@ def _sympy():
     return sympy
 
 
+#: maps symbol names created by :func:`to_sympy` back to their Fields so
+#: :func:`from_sympy` can restore them. Process-global by necessity (sympy
+#: symbols carry only a name); :func:`simplify` scopes its own additions,
+#: and :func:`reset_field_registry` clears the map for long-lived processes
+#: doing many unrelated conversions.
 _FIELD_REGISTRY: dict = {}
+
+
+def reset_field_registry():
+    """Clear the symbol→Field registry used by the sympy round trip.
+
+    After a reset, sympy expressions produced by *earlier* ``to_sympy``
+    calls can no longer be converted back with field restoration (their
+    symbols fall back to plain :class:`~pystella_tpu.field.Var`)."""
+    _FIELD_REGISTRY.clear()
 
 
 def SympyField(field, index=()):
@@ -157,4 +172,11 @@ def simplify(expr, sympify=None):
     """
     sym = _sympy()
     fn = sympify if sympify is not None else sym.simplify
-    return from_sympy(fn(to_sympy(expr)))
+    # scope this call's registry additions: the round trip completes inside
+    # the call, so its temporary symbol→Field entries need not outlive it
+    before = set(_FIELD_REGISTRY)
+    try:
+        return from_sympy(fn(to_sympy(expr)))
+    finally:
+        for name in set(_FIELD_REGISTRY) - before:
+            del _FIELD_REGISTRY[name]

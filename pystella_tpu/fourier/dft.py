@@ -71,32 +71,55 @@ def get_sliced_momenta(grid_shape, dtype, local_slice=None):
     return k
 
 
+def _self_conjugate_and_negative(n):
+    """Partition axis indices under mode negation ``i -> (-i) % n``: the
+    fixed points (``0`` and, for even ``n``, the Nyquist index) and the
+    strictly-negative-mode half ``i > n//2``."""
+    i = np.arange(n)
+    fixed = (i == 0) | ((n % 2 == 0) & (i == n // 2))
+    negative = i > n // 2
+    return fixed, negative
+
+
 def make_hermitian(fk):
     """Impose the Hermitian symmetry a real field's Fourier modes satisfy on
-    the r2c-layout array ``fk`` (shape ``(Nx, Ny, Nz//2+1)``): on the
-    ``kz = 0`` and ``kz = Nyquist`` planes set
-    ``fk[-i, -j] = conj(fk[i, j])``, and make the eight corner modes real
-    (reference rayleigh.py:35-54)."""
-    fk = np.asarray(fk)
-    grid_shape = list(fk.shape)
-    grid_shape[-1] = 2 * (grid_shape[-1] - 1)
-    pos = [np.arange(0, ni // 2 + 1) for ni in grid_shape]
-    neg = [np.concatenate([np.array([0]), np.arange(ni - 1, ni // 2 - 1, -1)])
-           for ni in grid_shape]
+    the r2c-layout array ``fk`` (shape ``(..., Nx, Ny, Nz//2+1)``): on the
+    ``kz = 0`` and ``kz = Nyquist`` planes, ``fk[-i, -j] = conj(fk[i, j])``,
+    and the eight self-conjugate corner modes are real (same contract as
+    reference rayleigh.py:35-54).
 
-    for k in [0, grid_shape[-1] // 2]:
-        for n, p in zip(neg[0], pos[0]):
-            fk[n, neg[1], k] = np.conj(fk[p, pos[1], k])
-            fk[p, neg[1], k] = np.conj(fk[n, pos[1], k])
-        for n, p in zip(neg[1], pos[1]):
-            fk[neg[0], n, k] = np.conj(fk[pos[0], p, k])
-            fk[neg[0], p, k] = np.conj(fk[pos[0], n, k])
+    Vectorized formulation: the (x, y) mirror ``fk[(-i) % Nx, (-j) % Ny]``
+    is a flip-then-roll, and each mode in the negative half-plane (``ky``
+    negative, or ``ky`` self-conjugate and ``kx`` negative) is overwritten
+    by the conjugate of its mirror — one ``where`` over the whole array, no
+    index loops. jit- and shard-compatible, so it runs on-device on the
+    sharded k-grid; per-mode amplitudes are preserved (each surviving mode
+    keeps its drawn amplitude), like the reference's copy-from-positive-half
+    assignment."""
+    on_host = isinstance(fk, np.ndarray)
+    arr = jnp.asarray(fk)
+    nx, ny, nzh = arr.shape[-3:]
+    nz = 2 * (nzh - 1)
 
-    for i in [0, grid_shape[0] // 2]:
-        for j in [0, grid_shape[1] // 2]:
-            for k in [0, grid_shape[2] // 2]:
-                fk[i, j, k] = np.real(fk[i, j, k])
-    return fk
+    # mirror in (x, y): index i -> (-i) % n  ==  roll(flip(axis), 1)
+    mirror = jnp.roll(jnp.flip(arr, axis=(-3, -2)), (1, 1), axis=(-3, -2))
+
+    fix_x, neg_x = _self_conjugate_and_negative(nx)
+    fix_y, neg_y = _self_conjugate_and_negative(ny)
+    # keep the positive half-plane, overwrite the negative one; ties on the
+    # self-conjugate ky columns are broken by kx
+    replace_xy = neg_y[None, :] | (fix_y[None, :] & neg_x[:, None])
+    corner_xy = fix_x[:, None] & fix_y[None, :]
+    kz_fixed = np.zeros(nzh, bool)
+    kz_fixed[0] = True
+    if nz:
+        kz_fixed[nz // 2] = True
+
+    replace = replace_xy[:, :, None] & kz_fixed
+    corner = corner_xy[:, :, None] & kz_fixed
+    out = jnp.where(replace, jnp.conj(mirror), arr)
+    out = jnp.where(corner, jnp.real(out).astype(out.dtype), out)
+    return np.asarray(out) if on_host else out
 
 
 class DFT:

@@ -1,14 +1,26 @@
 """Gaussian random field initialization in k-space.
 
 TPU-native counterpart of /root/reference/pystella/fourier/rayleigh.py:
-35-395: draws Rayleigh-distributed mode amplitudes with uniform phases for a
-chosen power spectrum, imposes the Hermitian symmetry of real fields, and
-inverse-transforms. Uses ``jax.random`` (Threefry — the same counter-based
-generator family the reference uses via pyopencl.clrandom, rayleigh.py:154).
+35-395: realizes Rayleigh-distributed mode amplitudes with uniform phases
+for a chosen power spectrum, with the Hermitian symmetry a real field's
+modes must satisfy, then inverse-transforms. Uses ``jax.random`` (Threefry —
+the same counter-based generator family the reference uses via
+pyopencl.clrandom, rayleigh.py:154).
 
-Mode generation happens once at setup on the host-resident k-grid (the
-Hermitian symmetrization is index-irregular and cheap there); the resulting
-fields are sharded device arrays.
+Design (a re-derivation, not a port): instead of drawing amplitudes and
+phases on the k-grid and then repairing the ``kz = {0, Nyquist}`` planes
+with an index-algebra symmetrization pass (the reference's
+``make_hermitian``, rayleigh.py:35-54), white Gaussian noise is drawn on
+the **position-space** lattice and forward-transformed. The DFT of real
+white noise *is* the Rayleigh-amplitude / uniform-phase ensemble — with the
+Hermitian constraint holding exactly by construction — so scaling those
+modes by ``sqrt(P(k))`` realizes the target spectrum with no fix-up pass.
+For ``random=False`` the noise modes are normalized to unit magnitude
+(keeping only their phases), reproducing the reference's deterministic
+amplitudes. Everything runs on device over the sharded lattice (the noise
+draw is sharded, the transform takes the pencil-FFT path), so no full-grid
+host array is ever materialized — at 512**3 the modes only ever exist as
+device shards.
 """
 
 from __future__ import annotations
@@ -16,8 +28,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
-
-from pystella_tpu.fourier.dft import make_hermitian
+import jax.numpy as jnp
 
 __all__ = ["RayleighGenerator"]
 
@@ -37,15 +48,13 @@ class RayleighGenerator:
         if fft is None:
             raise ValueError("fft is required")
         self.fft = fft
+        self.decomp = fft.decomp
         self.dtype = fft.dtype
         self.rdtype = fft.rdtype
         self.cdtype = fft.cdtype
         self.volume = volume
-
-        sub_k = list(fft.sub_k.values())
-        kvecs = np.meshgrid(*sub_k, indexing="ij", sparse=False)
-        self.kmags = np.sqrt(sum((dki * ki)**2
-                                 for dki, ki in zip(dk, kvecs)))
+        self.dk = tuple(float(d) for d in
+                        ((dk,) * 3 if np.isscalar(dk) else dk))
         # generated modes are in *unnormalized-forward-FFT* convention (the
         # convention PowerSpectra assumes), so fft.idft — which is normalized,
         # unlike the reference's raw FFTW backward (dft.py:424-427) — yields
@@ -53,38 +62,72 @@ class RayleighGenerator:
         self.grid_size = float(np.prod(fft.grid_shape))
         self.key = jax.random.key(seed)
 
+    @property
+    def kmags(self):
+        """Host wavenumber magnitudes over the k-grid (API-parity
+        convenience; generation itself never materializes this on host)."""
+        sub_k = list(self.fft.sub_k.values())
+        kvecs = np.meshgrid(*sub_k, indexing="ij", sparse=True)
+        return np.sqrt(sum((dki * ki)**2
+                           for dki, ki in zip(self.dk, kvecs)))
+
     def _next_key(self):
         self.key, sub = jax.random.split(self.key)
         return sub
 
-    def _uniform(self, n):
-        """n independent uniform(0, 1) arrays over the k-grid (host)."""
-        u = jax.random.uniform(
-            self._next_key(), (n,) + self.kmags.shape,
-            dtype=np.float64 if jax.config.jax_enable_x64 else np.float32,
-            minval=np.finfo(np.float32).tiny, maxval=1.0)
-        return np.asarray(jax.device_get(u)).astype(self.rdtype)
+    def _kmag_device(self):
+        """Sharded wavenumber magnitudes, broadcast from the per-axis mode
+        arrays (each sharded along its own lattice axis)."""
+        return jnp.sqrt(sum(
+            (jnp.asarray(dki, self.rdtype) * ki.astype(self.rdtype))**2
+            for dki, ki in zip(self.dk, self.fft.sub_k_device)))
 
-    def _post_process(self, fk):
+    def _protect_zero_mode(self, kmag):
+        """The ``k = 0`` protection of reference rayleigh.py:172-183: return
+        the zero-mode mask and ``kmag`` with that entry replaced by its
+        kz-neighbor's magnitude (a host-computed scalar, so no gather from
+        the sharded array is needed); callers zero the mode's power after
+        evaluating the spectrum."""
+        k_ax = list(self.fft.sub_k.values())
+        neighbor = np.sqrt(sum(
+            (dki * ki[idx])**2
+            for dki, ki, idx in zip(self.dk, k_ax, (0, 0, 1))))
+        zero = kmag == 0
+        return zero, jnp.where(zero, jnp.asarray(neighbor, self.rdtype),
+                               kmag)
+
+    def _noise_modes(self, key):
+        """Fourier modes of a unit white-noise lattice: complex Gaussian
+        with ``E|n_k|^2 = grid_size``, uniform phases, and (for real
+        ``dtype``) exact Hermitian symmetry by construction."""
+        shape = self.fft.grid_shape
+        sharding = self.decomp.sharding(0)
         if self.fft.is_real:
-            fk = make_hermitian(fk)
-            fk = self.fft.zero_corner_modes(fk, only_imag=True)
-        return fk
+            noise = jax.jit(
+                lambda k: jax.random.normal(k, shape, self.rdtype),
+                out_shardings=sharding)(key)
+        else:
+            noise = jax.jit(
+                lambda k: (lambda u: (u[0] + 1j * u[1])
+                           / np.sqrt(2.0).astype(self.rdtype))(
+                    jax.random.normal(k, (2,) + shape, self.rdtype)),
+                out_shardings=sharding)(key)
+        return self.fft.dft(noise)
 
-    def _ps_wrapper(self, ps_func, wk, kmags):
-        """Evaluate a power spectrum, protecting the k=0 mode (reference
-        rayleigh.py:172-183)."""
-        found_zero = kmags[0, 0, 0] == 0.0
-        wk = np.array(wk)
-        if found_zero:
-            wk0 = wk[0, 0, 0]
-            wk[0, 0, 0] = wk[0, 0, 1]
-        power = np.asarray(ps_func(wk), self.rdtype)
-        if found_zero:
-            power = np.array(power)
-            power[0, 0, 0] = 0.0
-            wk[0, 0, 0] = wk0
-        return power
+    def _scale(self, nk, f_power_fn, random):
+        """Scale noise modes to the target spectrum: Rayleigh amplitudes
+        for ``random=True``, exactly ``sqrt(P)`` (phase only) otherwise."""
+        def impl(nk):
+            f_power = f_power_fn()
+            root = jnp.sqrt(f_power).astype(self.rdtype)
+            if random:
+                return (nk * (root / np.sqrt(self.grid_size))
+                        ).astype(self.cdtype)
+            mag = jnp.abs(nk)
+            phase = jnp.where(mag > 0, nk / jnp.where(mag > 0, mag, 1),
+                              jnp.asarray(1, self.cdtype))
+            return (phase * root).astype(self.cdtype)
+        return jax.jit(impl, out_shardings=self.decomp.sharding(0))(nk)
 
     def generate(self, queue=None, random=True,
                  field_ps=lambda kmag: 1 / 2 / kmag,
@@ -92,21 +135,21 @@ class RayleighGenerator:
         """Generate Fourier modes with power spectrum ``field_ps`` and
         random phases (reference rayleigh.py:185-226).
 
-        :returns: host ``np.ndarray`` of modes (pass through
-            ``fft.idft`` / :meth:`init_field` for the position-space field).
+        :returns: sharded device array of modes (pass through ``fft.idft``
+            / :meth:`init_field` for the position-space field).
         """
         amplitude_sq = norm / self.volume * self.grid_size**2
-        rands = self._uniform(2)
-        if not random:
-            rands[0] = np.exp(-1)
 
-        f_power = (amplitude_sq * window(self.kmags)**2
-                   * self._ps_wrapper(field_ps, self.kmags, self.kmags))
+        def f_power_fn():
+            kmag = self._kmag_device()
+            zero, kmag_safe = self._protect_zero_mode(kmag)
+            return (amplitude_sq * window(kmag)**2
+                    * jnp.where(zero, jnp.asarray(0, self.rdtype),
+                                jnp.asarray(field_ps(kmag_safe),
+                                            self.rdtype)))
 
-        amp = np.sqrt(-np.log(rands[0]))
-        phs = np.exp(2j * np.pi * rands[1]).astype(self.cdtype)
-        fk = phs * amp * np.sqrt(f_power)
-        return self._post_process(fk)
+        nk = self._noise_modes(self._next_key())
+        return self._scale(nk, f_power_fn, random)
 
     def init_field(self, fx=None, queue=None, **kwargs):
         """Initialize a position-space field with :meth:`generate`'s modes;
@@ -120,8 +163,8 @@ class RayleighGenerator:
         """Initialize a transverse 3-vector field (same power spectrum per
         component); returns the ``(3,) + grid_shape`` array (reference
         rayleigh.py:247-278)."""
-        vector_k = np.stack([self.generate(**kwargs) for _ in range(3)])
-        vector_k = projector.transversify(self.fft.decomp.shard(vector_k))
+        vector_k = jnp.stack([self.generate(**kwargs) for _ in range(3)])
+        vector_k = projector.transversify(vector_k)
         return self.fft.idft(vector_k)
 
     def init_vector_from_pol(self, projector, vector=None, plus_ps=None,
@@ -130,10 +173,8 @@ class RayleighGenerator:
         (reference rayleigh.py:280-323)."""
         if plus_ps is None or minus_ps is None:
             raise ValueError("plus_ps and minus_ps are required")
-        plus_k = self.fft.decomp.shard(
-            self.generate(field_ps=plus_ps, **kwargs))
-        minus_k = self.fft.decomp.shard(
-            self.generate(field_ps=minus_ps, **kwargs))
+        plus_k = self.generate(field_ps=plus_ps, **kwargs)
+        minus_k = self.generate(field_ps=minus_ps, **kwargs)
         vector_k = projector.pol_to_vec(plus_k, minus_k)
         return self.fft.idft(vector_k)
 
@@ -142,34 +183,51 @@ class RayleighGenerator:
                      norm=1, omega_k=lambda kmag: kmag,
                      hubble=0.0, window=lambda kmag: 1.0):
         """Generate modes for a field and its conformal-time derivative in
-        the WKB approximation (reference rayleigh.py:325-373):
-        left/right-moving modes with dispersion ``omega_k`` and Hubble drag,
-        ``dfk = i ω (L - R)/√2 - H fk``.
+        the WKB approximation (reference rayleigh.py:325-373): left/right-
+        moving modes with dispersion ``omega_k`` and Hubble drag,
+        ``fk = (L + R)/√2``, ``dfk = i ω (L - R)/√2 - H fk``.
 
-        :returns: host ``(fk, dfk)`` arrays.
+        Realized here in the manifestly-Hermitian equivalent form: writing
+        the free (unconstrained) complex mode field ``α = (N1 + i N2)/√2``
+        with ``N1``, ``N2`` two independent real-noise transforms, the
+        left/right pair of a real field is ``L_k = α_k``,
+        ``R_k = conj(α_{-k})``, and substituting gives ``L + R = √2 N1``
+        and ``i(L - R) = -√2 N2`` — so ``fk ∝ N1`` and
+        ``dfk ∝ ω N2 - H fk``, each a real-coefficient scaling of an
+        exactly-Hermitian noise transform. Marginals and the f–df cross-
+        correlation (``-H P``) match the reference's construction; unlike
+        it, no post-hoc symmetrization pass is needed.
+
+        :returns: sharded ``(fk, dfk)`` device arrays.
         """
         amplitude_sq = norm / self.volume * self.grid_size**2
-        rands = self._uniform(4)
-        if not random:
-            rands[0] = rands[2] = np.exp(-1)
 
-        wk = np.asarray(omega_k(self.kmags), self.rdtype)
-        f_power = (amplitude_sq * window(self.kmags)**2
-                   * self._ps_wrapper(field_ps, wk, self.kmags))
+        def f_power_fn():
+            kmag = self._kmag_device()
+            zero, kmag_safe = self._protect_zero_mode(kmag)
+            # pointwise omega, so evaluating at the protected kmag equals
+            # the reference's protect-evaluate-restore on wk; the zero mode
+            # has zero power either way, making the wk value there inert
+            wk = jnp.asarray(omega_k(kmag_safe), self.rdtype)
+            return (amplitude_sq * window(kmag)**2
+                    * jnp.where(zero, jnp.asarray(0, self.rdtype),
+                                jnp.asarray(field_ps(wk), self.rdtype)))
 
-        amp1 = np.sqrt(-np.log(rands[0]))
-        amp2 = np.sqrt(-np.log(rands[2]))
-        phs1 = np.exp(2j * np.pi * rands[1]).astype(self.cdtype)
-        phs2 = np.exp(2j * np.pi * rands[3]).astype(self.cdtype)
+        fk = self._scale(self._noise_modes(self._next_key()),
+                         f_power_fn, random)
+        dfree = self._scale(self._noise_modes(self._next_key()),
+                            f_power_fn, random)
 
-        sqrt_power = np.sqrt(f_power)
-        lmode = phs1 * amp1 * sqrt_power
-        rmode = phs2 * amp2 * sqrt_power
-        rt2 = np.sqrt(2.0)
-        fk = (lmode + rmode) / rt2
-        dfk = 1j * wk * (lmode - rmode) / rt2 - hubble * fk
+        def combine(fk, dfree):
+            kmag = self._kmag_device()
+            _, kmag_safe = self._protect_zero_mode(kmag)
+            wk = jnp.asarray(omega_k(kmag_safe), self.rdtype)
+            dfk = (wk * dfree - hubble * fk).astype(self.cdtype)
+            return fk, dfk
 
-        return self._post_process(fk), self._post_process(dfk)
+        sharding = self.decomp.sharding(0)
+        return jax.jit(combine, out_shardings=(sharding, sharding))(
+            fk, dfree)
 
     def init_WKB_fields(self, fx=None, dfx=None, queue=None, **kwargs):
         """Initialize a field and its time derivative via WKB modes; returns

@@ -20,8 +20,10 @@ __all__ = ["Sector", "ScalarSector", "TensorPerturbationSector",
 
 
 def tensor_index(i, j):
-    """Symmetric rank-2 index packing to length-6 (1-indexed; reference
-    sectors.py:164-167)."""
+    """Pack 1-based symmetric rank-2 indices ``(i, j)`` into a 0-based
+    length-6 storage index (``tensor_index(1, 1) == 0``; reference
+    sectors.py:164-167 returns 1-based values, callers here key
+    ``range(6)``)."""
     a, b = min(i, j), max(i, j)
     return (7 - a) * a // 2 - 4 + b
 

@@ -3,10 +3,21 @@
 TPU-native counterpart of /root/reference/pystella/histogram.py:33-350. The
 reference uses a two-level atomic scatter kernel (workgroup-local atomics,
 barrier, global atomic flush) followed by an MPI allreduce of the host copy.
-XLA has no atomics; instead each device computes a local ``jnp.bincount``
-over its shard inside ``shard_map`` and the per-device histograms are summed
-with ``lax.psum`` over the mesh — deterministic by construction (no
+XLA has no atomics; instead each device computes local ``jnp.bincount``s
+over its shard inside ``shard_map`` — deterministic by construction (no
 write-race silencing needed, cf. histogram.py:111-112).
+
+Accumulation precision (production lattices exceed f32's 2**24 integer
+range — a 512**3 grid has 1.3e8 sites, so a single bin can overflow exact
+f32 counting even though TPUs have no native f64): each device's flat shard
+is split into chunks of at most 2**22 elements, each chunk is bincounted
+separately (int32 for pure counts, f32 for weighted sums — every per-chunk
+partial stays exactly representable), the per-device per-chunk partials are
+returned without any device-side reduction, and the final sum over chunks
+and devices happens on the host in int64/float64. Counts are therefore
+exact at any scale regardless of ``jax_enable_x64`` (matching the
+reference's f64 device accumulation, histogram.py:199-206); weighted sums
+carry at most one f32 rounding per 2**22-element chunk.
 """
 
 from __future__ import annotations
@@ -31,41 +42,88 @@ __all__ = ["Histogrammer", "FieldHistogrammer", "weighted_bincount"]
 _bincount_cache = weakref.WeakKeyDictionary()
 
 
-def _bincount_fn(decomp, outer_shape, num_bins):
-    """Build (and cache) the jitted distributed weighted-bincount for a
-    given decomposition / outer shape / bin count."""
+#: largest per-chunk element count; keeps every per-chunk partial (int32
+#: count or f32 weighted sum of same-order values) exactly representable
+_CHUNK = 1 << 22
+
+
+def _bincount_fn(decomp, outer_shape, num_bins, weighted):
+    """Build (and cache) the jitted distributed chunked bincount for a
+    given decomposition / outer shape / bin count. Returns per-device,
+    per-chunk partial histograms stacked along axis 0 (the host finalizes
+    in wide precision)."""
     per_decomp = _bincount_cache.setdefault(decomp, {})
-    cached = per_decomp.get((outer_shape, num_bins))
+    key = (outer_shape, num_bins, weighted)
+    cached = per_decomp.get(key)
     if cached is not None:
         return cached
     from jax.sharding import PartitionSpec as P
     nouter = int(np.prod(outer_shape, dtype=np.int64)) if outer_shape else 1
+    length = num_bins * nouter
     spec = decomp.spec(len(outer_shape))
-    out_spec = P(*(None,) * (len(outer_shape) + 1))
+    # partials stay sharded along the stacked chunk axis — no device-side
+    # reduction, so no precision-losing f32/int32 cross-device sums
+    out_spec = P(decomp.reduce_axes or None, None)
 
-    def local(b, w):
+    def flat_chunked_bins(b):
         if nouter > 1:
             # offset bins per outer slice: one bincount covers all slices
             offsets = jnp.arange(nouter, dtype=jnp.int32).reshape(
                 outer_shape + (1, 1, 1))
             b = b + offsets * num_bins
-        h = jnp.bincount(b.reshape(-1), weights=w.reshape(-1),
-                         length=num_bins * nouter)
-        return decomp.psum(h).reshape(outer_shape + (num_bins,))
+        flat = b.reshape(-1)
+        n = flat.size
+        nchunks = -(-n // _CHUNK)
+        chunk = -(-n // nchunks)
+        pad = nchunks * chunk - n
+        if pad:
+            # padded elements go to a sentinel bin that is dropped below
+            flat = jnp.concatenate(
+                [flat, jnp.full((pad,), length, flat.dtype)])
+        return flat.reshape(nchunks, chunk), nchunks, chunk, pad
 
-    fn = jax.jit(decomp.shard_map(local, (spec, spec), out_spec))
-    per_decomp[(outer_shape, num_bins)] = fn
+    if weighted:
+        def local(b, w):
+            bb, nchunks, chunk, pad = flat_chunked_bins(b)
+            flat_w = w.reshape(-1)
+            if pad:
+                flat_w = jnp.concatenate(
+                    [flat_w, jnp.zeros((pad,), flat_w.dtype)])
+            ww = flat_w.reshape(nchunks, chunk)
+            return jax.vmap(
+                lambda bi, wi: jnp.bincount(
+                    bi, weights=wi, length=length + 1)[:length])(bb, ww)
+        in_specs = (spec, spec)
+    else:
+        def local(b):
+            bb, *_ = flat_chunked_bins(b)
+            return jax.vmap(
+                lambda bi: jnp.bincount(bi, length=length + 1)[:length])(bb)
+        in_specs = (spec,)
+
+    fn = jax.jit(decomp.shard_map(local, in_specs, out_spec))
+    per_decomp[key] = fn
     return fn
 
 
 def weighted_bincount(decomp, bins, weights, num_bins):
-    """Distributed weighted histogram: per-device ``jnp.bincount`` over the
-    local shard + ``psum`` over the mesh. ``bins`` (int32) and ``weights``
-    share shape ``outer + lattice``; returns ``outer + (num_bins,)``,
-    replicated. The shared primitive behind :class:`Histogrammer` and
+    """Distributed histogram: chunked per-device ``jnp.bincount``s with
+    host-side wide-precision finalization (see module docstring). ``bins``
+    (int32) has shape ``outer + lattice``; ``weights`` shares it, or is
+    ``None`` for an exact integer count histogram. Returns a **host**
+    ``np.ndarray`` of shape ``outer + (num_bins,)`` (float64, or int64 for
+    counts). The shared primitive behind :class:`Histogrammer` and
     :class:`~pystella_tpu.PowerSpectra`."""
     outer_shape = tuple(bins.shape[:-3])
-    return _bincount_fn(decomp, outer_shape, int(num_bins))(bins, weights)
+    num_bins = int(num_bins)
+    if weights is None:
+        partials = _bincount_fn(decomp, outer_shape, num_bins, False)(bins)
+        h = np.asarray(partials).astype(np.int64).sum(axis=0)
+    else:
+        partials = _bincount_fn(decomp, outer_shape, num_bins, True)(
+            bins, weights)
+        h = np.asarray(partials).astype(np.float64).sum(axis=0)
+    return h.reshape(outer_shape + (num_bins,))
 
 
 class Histogrammer:
@@ -88,26 +146,35 @@ class Histogrammer:
 
         num_bins_ = self.num_bins
 
+        def is_unit(expr):
+            if isinstance(expr, _field.Constant):
+                expr = expr.value
+            return isinstance(expr, (int, float)) and expr == 1
+
+        #: histograms with a constant unit weight take the exact integer
+        #: count path (no f32 rounding at any lattice size)
+        self._count_names = {name for name, (_, w)
+                             in self.histograms.items() if is_unit(w)}
+
         def prepare(env):
             out = {}
             for name, (bin_expr, weight_expr) in self.histograms.items():
                 b = _field.evaluate(bin_expr, env)
-                w = _field.evaluate(weight_expr, env)
-                # accumulate in the requested dtype (canonicalized: f64 only
-                # when x64 is enabled) so large counts don't saturate in f32
-                acc_dtype = jnp.zeros((), self.dtype).dtype
                 b = jnp.clip(jnp.floor(b), 0, num_bins_ - 1).astype(jnp.int32)
-                w = jnp.broadcast_to(w, b.shape).astype(acc_dtype)
-                out[name] = (b, w)
+                if name in self._count_names:
+                    out[name] = (b, None)
+                    continue
+                w = _field.evaluate(weight_expr, env)
+                acc = jnp.zeros((), self.dtype).dtype  # canonicalized
+                out[name] = (b, jnp.broadcast_to(w, b.shape).astype(acc))
             return out
 
         self._prepare = jax.jit(prepare)
 
     def __call__(self, allocator=None, **env):
         prepared = self._prepare(env)
-        return {name: np.asarray(
-                    weighted_bincount(self.decomp, b, w, self.num_bins)
-                ).astype(self.dtype)
+        return {name: weighted_bincount(
+                    self.decomp, b, w, self.num_bins).astype(self.dtype)
                 for name, (b, w) in prepared.items()}
 
 
@@ -141,6 +208,27 @@ class FieldHistogrammer(Histogrammer):
             "min_log_f": [(_field.log(_field.fabs(f)), "min")],
         })
 
+    def _sanitize_bounds(self, bounds):
+        """Keep automatic bin bounds finite and non-degenerate: a field with
+        zeros gives ``log|f| = -inf`` (an identically-zero field gives
+        degenerate bounds in both binnings), which would turn the bin
+        expressions into nan. Infinite log-bounds clamp to the dtype's
+        tiniest normal; equal bounds widen by one unit so every site lands
+        in bin 0 with finite bin edges."""
+        out = dict(bounds)
+        tiny_log = float(np.log(np.finfo(self.dtype).tiny))
+        lo, hi = float(out["min_log_f"]), float(out["max_log_f"])
+        if not np.isfinite(hi):
+            hi = tiny_log
+        if not np.isfinite(lo):
+            lo = min(tiny_log, hi)
+        if lo == hi:
+            hi = lo + 1.0
+        out["min_log_f"], out["max_log_f"] = lo, hi
+        if float(out["min_f"]) == float(out["max_f"]):
+            out["max_f"] = float(out["min_f"]) + 1.0
+        return out
+
     def __call__(self, f, allocator=None, **kwargs):
         outer_shape = f.shape[:-3]
         slices = list(product(*[range(n) for n in outer_shape]))
@@ -160,6 +248,7 @@ class FieldHistogrammer(Histogrammer):
                 bounds = {key: np.asarray(val) for key, val in bounds.items()}
             else:
                 bounds = {key: kwargs[key][s] for key in min_max_keys}
+            bounds = self._sanitize_bounds(bounds)
 
             hists = super().__call__(f=f[s], **bounds)
             for key, val in hists.items():

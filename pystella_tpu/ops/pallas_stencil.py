@@ -79,6 +79,16 @@ def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
                 if best is None or bx * by > best[0] * best[1]:
                     best = (bx, by)
     if best is None:
+        if Y % 8:
+            # the streaming kernel's y-slab math assumes by >= the 8-aligned
+            # halo width, so lattices whose Y is not a multiple of 8 have no
+            # feasible blocking at all — say so clearly (callers like
+            # FiniteDifferencer catch this and take the halo path)
+            raise ValueError(
+                f"lattice y extent {Y} is not a multiple of 8: no feasible "
+                "pallas/fused streaming-stencil blocking; use the halo-"
+                "exchange operators (FiniteDifferencer mode='halo') or the "
+                "generic steppers instead")
         bx = next((b for b in (8, 4, 2, 1) if X % b == 0 and b >= h), 1)
         return bx, 8
     return best

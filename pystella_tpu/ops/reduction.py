@@ -92,8 +92,14 @@ class Reduction:
         self._run = jax.jit(run, static_argnums=())
 
     def __call__(self, allocator=None, **env):
-        first = next(a for a in env.values() if hasattr(a, "ndim")
-                     and getattr(a, "ndim", 0) >= 3)
+        first = next((a for a in env.values() if hasattr(a, "ndim")
+                      and getattr(a, "ndim", 0) >= 3), None)
+        if first is None:
+            raise ValueError(
+                "Reduction needs at least one lattice (>= 3-D) array "
+                f"argument to infer the grid size; got only scalars/"
+                f"low-rank values for {sorted(env)}; pass grid_size= at "
+                "construction or include a lattice array")
         grid_size = self.grid_size or int(np.prod(first.shape[-3:]))
         result = self._run(env, grid_size)
         result = {k: np.asarray(v) for k, v in result.items()}

@@ -56,6 +56,11 @@ class HealthMonitor:
 
         self._check = check
 
+    def check_now(self, state):
+        """Run the health check unconditionally (e.g. immediately before a
+        checkpoint save); raises :class:`SimulationDiverged` on failure."""
+        return self.__call__(0, state)
+
     def __call__(self, step, state):
         """Check (every ``self.every`` steps); raises
         :class:`SimulationDiverged` on failure, else returns True if the

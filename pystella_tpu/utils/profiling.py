@@ -75,14 +75,20 @@ class StepTimer:
 
     def __init__(self, report_every=30.0):
         self.report_every = float(report_every)
-        now = time.perf_counter()
-        self.last_report = now
+        # the clock starts at the FIRST tick, not at construction, so the
+        # first reported window covers steps 2..N and excludes the first
+        # step's jit compilation
+        self.last_report = None
         self.steps_at_report = 0
         self.steps = 0
 
     def tick(self):
         self.steps += 1
         now = time.perf_counter()
+        if self.last_report is None:
+            self.last_report = now
+            self.steps_at_report = self.steps
+            return None
         if now - self.last_report < self.report_every:
             return None
         window_steps = self.steps - self.steps_at_report

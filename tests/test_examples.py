@@ -12,12 +12,14 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: this framework's golden Friedmann-constraint value for the 32³
-#: scalar-preheating run to t=1 (seed 49279). The reference's golden value
-#: for the same configuration is 5.5725530301309334e-08
-#: (/root/reference/test/test_examples.py:33) — the ~0.7% difference is the
-#: RNG realization of the WKB fluctuations; the deterministic background
-#: integration error dominates both.
-GOLDEN_CONSTRAINT = 5.5351373151601990e-08
+#: scalar-preheating run to t=1 (seed 49279), rebaselined when the WKB
+#: initialization moved to device-side noise-transform generation (round 2
+#: — same seed, different draw order, hence a new random realization).
+#: The reference's golden value for the same configuration is
+#: 5.5725530301309334e-08 (/root/reference/test/test_examples.py:33) — the
+#: ~1% spread across realizations is the RNG draw of the WKB fluctuations;
+#: the deterministic background integration error dominates both.
+GOLDEN_CONSTRAINT = 5.6021274619233452e-08
 
 
 def run_example(script, *args):

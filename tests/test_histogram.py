@@ -86,3 +86,21 @@ def test_field_histogrammer_log(decomp, grid_shape):
         range=(np.log(fx).min(), np.log(fx).max()))
     assert np.allclose(out["log_bins"], np.exp(edges), rtol=1e-10)
     assert np.allclose(out["log"], expected, atol=2)
+
+
+def test_field_histogrammer_zero_field(decomp, grid_shape):
+    """An identically-zero field must produce finite bins and counts (the
+    log of |f| is -inf everywhere; the automatic bounds are sanitized)."""
+    fh = ps.FieldHistogrammer(decomp, 8)
+    out = fh(decomp.zeros(grid_shape, np.float64))
+    for key in ("linear", "log", "linear_bins", "log_bins"):
+        assert np.all(np.isfinite(out[key])), key
+    # every site lands in some bin
+    assert out["linear"].sum() == pytest.approx(np.prod(grid_shape))
+    assert out["log"].sum() == pytest.approx(np.prod(grid_shape))
+
+
+def test_reduction_requires_lattice_arg(decomp):
+    red = ps.Reduction(decomp, {"e": [(ps.Field("f"), "avg")]})
+    with pytest.raises(ValueError, match="lattice"):
+        red(f=np.float64(3.0))
