@@ -40,8 +40,8 @@ class SpectralCollocator:
             k1 = k2.copy()
             k1[np.abs(kk_int) == fft.grid_shape[mu] // 2] = 0.0
             k1[kk_int == 0] = 0.0
-            self._k1.append(decomp.axis_array(mu, k1))
-            self._k2.append(decomp.axis_array(mu, k2))
+            self._k1.append(decomp.axis_array(mu, k1, sharded=(mu != 2)))
+            self._k2.append(decomp.axis_array(mu, k2, sharded=(mu != 2)))
 
         self._lap = jax.jit(self._lap_impl)
         self._grad = jax.jit(self._grad_impl)

@@ -13,18 +13,23 @@ Conventions match the reference:
 - forward transform unnormalized, backward normalized (``idft(dft(x)) == x``);
 - mode numbers from :func:`fftfreq` with *positive* Nyquist
   (reference dft.py:327-332);
-- the z axis is never decomposed (so the r2c half-spectrum stays local),
-  matching the reference's decomposition rule (decomp.py:129-130).
+- the r2c half-spectrum z axis stays local in *k-space* on every mesh.
+  Unlike the reference (which forbids z decomposition outright,
+  decomp.py:129-130), position-space z sharding is supported: the transform
+  reshards to an x-only pencil first so z is local.
 """
 
 from __future__ import annotations
 
+import logging
 from itertools import product
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["DFT", "fftfreq", "pfftfreq", "make_hermitian",
            "get_real_dtype_with_matching_prec",
@@ -125,8 +130,11 @@ def make_hermitian(fk):
 class DFT:
     """Forward/backward 3-D (r2c or c2c) FFTs of sharded lattice arrays.
 
-    :arg decomp: a :class:`~pystella_tpu.DomainDecomposition`; its z mesh
-        axis must be 1 (the half-spectrum axis stays local).
+    :arg decomp: a :class:`~pystella_tpu.DomainDecomposition`. All mesh
+        shapes are supported (the reference forbids z decomposition,
+        decomp.py:129-130); on z-sharded meshes the transform first
+        reshards to an x-only pencil so the z axis is local, and k-space
+        arrays keep the (half-spectrum) z axis unsharded.
     :arg grid_shape: position-space shape.
     :arg dtype: position-space dtype; a real dtype selects r2c transforms.
 
@@ -145,19 +153,36 @@ class DFT:
         self.rdtype = get_real_dtype_with_matching_prec(self.dtype)
         self.cdtype = get_complex_dtype_with_matching_prec(self.dtype)
 
-        if decomp.proc_shape[2] != 1:
-            raise ValueError(
-                "DFT requires an undecomposed z axis (proc_shape[2] == 1), "
-                "matching the reference decomposition rule")
-
         # pencil scheme feasibility: the x and y axes are resharded over the
         # *combined* mesh axes between per-axis FFTs, so both must divide by
         # the total device count (documented design decision; uneven shards
-        # fall back to a replicate-transform-reshard path)
+        # fall back to a replicate-transform-reshard path). Unlike the
+        # reference (z decomposition is NotImplementedError, decomp.py:129-130)
+        # z-sharded meshes are supported: the transform starts by resharding
+        # to an x-only pencil so the z axis is local, and k-space arrays keep
+        # the (half-spectrum) z axis unsharded.
         nproc = int(np.prod(decomp.proc_shape))
         self._pencil_ok = (self.grid_shape[0] % nproc == 0
                            and self.grid_shape[1] % nproc == 0)
         self._nproc = nproc
+        self._z_sharded = decomp.proc_shape[2] > 1
+        if self._z_sharded and self._pencil_ok:
+            logger.info(
+                "DFT %s on a z-sharded mesh %s: the 3-D->x-pencil reshard "
+                "may be lowered inefficiently by the current XLA SPMD "
+                "partitioner (it can replicate the operand; see the XLA "
+                "'involuntary full rematerialization' warning if emitted). "
+                "x/y-only meshes take the tuned pencil path.",
+                self.grid_shape, decomp.proc_shape)
+        if nproc > 1 and not self._pencil_ok:
+            logger.warning(
+                "DFT %s on %d devices: grid x/y axes do not divide the "
+                "device count, so the pencil scheme is infeasible — "
+                "transforms will REPLICATE the array on every device and "
+                "run redundantly (correct, but an OOM/bandwidth cliff at "
+                "production sizes). Choose grid/mesh shapes with "
+                "grid_shape[0] %% ndev == 0 and grid_shape[1] %% ndev == 0.",
+                self.grid_shape, nproc)
 
         k = [fftfreq(n).astype(self.rdtype) for n in self.grid_shape]
         if self.is_real:
@@ -170,8 +195,8 @@ class DFT:
                       in zip(("momenta_x", "momenta_y", "momenta_z"), k)}
 
         # device copies shaped for broadcasting against k-space arrays,
-        # sharded to match their lattice axes
-        self.sub_k_device = [decomp.axis_array(mu, ki)
+        # sharded to match their lattice axes (k-space keeps z unsharded)
+        self.sub_k_device = [decomp.axis_array(mu, ki, sharded=(mu != 2))
                              for mu, ki in enumerate(k)]
 
         self._dft = jax.jit(self._dft_impl)
@@ -202,11 +227,12 @@ class DFT:
         decomp = self.decomp
         names = [n if decomp.proc_shape[i] > 1 else None
                  for i, n in enumerate(decomp.axis_names)]
-        mixed = tuple(n for n in names[:2] if n is not None)
+        mixed = tuple(n for n in names if n is not None)
         o = (None,) * outer
-        return (P(*o, names[0], names[1], None),      # home layout
-                P(*o, mixed or None, None, None),     # x sharded, y/z local
-                P(*o, None, mixed or None, None))     # y sharded, x/z local
+        return (P(*o, names[0], names[1], names[2]),   # position-space home
+                P(*o, names[0], names[1], None),       # k-space home, z local
+                P(*o, mixed or None, None, None),      # x sharded, y/z local
+                P(*o, None, mixed or None, None))      # y sharded, x/z local
 
     def _dft_impl(self, fx):
         from jax.sharding import reshard
@@ -214,19 +240,24 @@ class DFT:
         if self._nproc == 1:
             return (jnp.fft.rfftn if self.is_real else jnp.fft.fftn)(
                 fx, axes=(-3, -2, -1))
-        home, x_shard, y_shard = self._specs(outer)
+        phome, khome, x_shard, y_shard = self._specs(outer)
         if not self._pencil_ok:
             full = jax.sharding.PartitionSpec(*(None,) * fx.ndim)
             xk = reshard(fx, full)
             xk = (jnp.fft.rfftn if self.is_real else jnp.fft.fftn)(
                 xk, axes=(-3, -2, -1))
-            return reshard(xk, home)
-        xk = (jnp.fft.rfft if self.is_real else jnp.fft.fft)(fx, axis=-1)
-        xk = reshard(xk, x_shard)
+            return reshard(xk, khome)
+        if self._z_sharded:
+            # make z local before the first axis transform
+            xk = reshard(fx, x_shard)
+            xk = (jnp.fft.rfft if self.is_real else jnp.fft.fft)(xk, axis=-1)
+        else:
+            xk = (jnp.fft.rfft if self.is_real else jnp.fft.fft)(fx, axis=-1)
+            xk = reshard(xk, x_shard)
         xk = jnp.fft.fft(xk, axis=-2)
         xk = reshard(xk, y_shard)
         xk = jnp.fft.fft(xk, axis=-3)
-        return reshard(xk, home)
+        return reshard(xk, khome)
 
     def _idft_impl(self, fk):
         from jax.sharding import reshard
@@ -235,7 +266,7 @@ class DFT:
             if self.is_real:
                 return jnp.fft.irfftn(fk, s=self.grid_shape, axes=(-3, -2, -1))
             return jnp.fft.ifftn(fk, axes=(-3, -2, -1))
-        home, x_shard, y_shard = self._specs(outer)
+        phome, khome, x_shard, y_shard = self._specs(outer)
         if not self._pencil_ok:
             full = jax.sharding.PartitionSpec(*(None,) * fk.ndim)
             xk = reshard(fk, full)
@@ -243,12 +274,19 @@ class DFT:
                 xk = jnp.fft.irfftn(xk, s=self.grid_shape, axes=(-3, -2, -1))
             else:
                 xk = jnp.fft.ifftn(xk, axes=(-3, -2, -1))
-            return reshard(xk, home)
+            return reshard(xk, phome)
         xk = reshard(fk, y_shard)
         xk = jnp.fft.ifft(xk, axis=-3)
         xk = reshard(xk, x_shard)
         xk = jnp.fft.ifft(xk, axis=-2)
-        xk = reshard(xk, home)
+        if self._z_sharded:
+            # finish the z transform while z is still local, then go home
+            if self.is_real:
+                xk = jnp.fft.irfft(xk, n=self.grid_shape[-1], axis=-1)
+            else:
+                xk = jnp.fft.ifft(xk, axis=-1)
+            return reshard(xk, phome)
+        xk = reshard(xk, khome)
         if self.is_real:
             return jnp.fft.irfft(xk, n=self.grid_shape[-1], axis=-1)
         return jnp.fft.ifft(xk, axis=-1)
@@ -257,6 +295,19 @@ class DFT:
         """Context entering this decomposition's mesh (required by
         ``reshard`` at trace time)."""
         return jax.set_mesh(self.decomp.mesh)
+
+    def k_sharding(self, outer_axes=0):
+        """``NamedSharding`` of k-space arrays: x/y as the decomposition,
+        the (half-spectrum) z axis always local."""
+        from jax.sharding import NamedSharding
+        _, khome, _, _ = self._specs(outer_axes)
+        return NamedSharding(self.decomp.mesh, khome)
+
+    def shard_k(self, array, outer_axes=None):
+        """Place a host k-space array on the mesh in the k-home layout."""
+        if outer_axes is None:
+            outer_axes = array.ndim - 3
+        return jax.device_put(array, self.k_sharding(outer_axes))
 
     def dft(self, fx=None, fk=None, **kwargs):
         """Forward transform. Returns the momentum-space array (the ``fk``
@@ -271,7 +322,7 @@ class DFT:
         """Backward (normalized) transform. Returns the position-space
         array."""
         arr = fk if not isinstance(fk, np.ndarray) else \
-            self.decomp.shard(np.asarray(fk, self.cdtype))
+            self.shard_k(np.asarray(fk, self.cdtype))
         with self._with_mesh():
             out = self._idft(arr)
         if self.is_real:
@@ -299,4 +350,4 @@ class DFT:
 
         if on_host:
             return arr
-        return self.decomp.shard(arr, outer_axes=arr.ndim - 3)
+        return self.shard_k(arr)

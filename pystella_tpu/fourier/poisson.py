@@ -35,7 +35,8 @@ class SpectralPoissonSolver:
         decomp = fft.decomp
         self._eig = [
             decomp.axis_array(mu, np.asarray(
-                effective_k(dk[mu] * kk.astype(rdtype), dx[mu]), rdtype))
+                effective_k(dk[mu] * kk.astype(rdtype), dx[mu]), rdtype),
+                sharded=(mu != 2))
             for mu, kk in enumerate(fft.sub_k.values())]
 
         def solve(rho, m_squared):

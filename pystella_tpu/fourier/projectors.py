@@ -63,7 +63,8 @@ class Projector:
             eff[np.abs(kk_int) == fft.grid_shape[mu] // 2] = 0.0
             eff[kk_int == 0] = 0.0
             self.eff_mom[name] = eff
-            self._eff_dev.append(decomp.axis_array(mu, eff))
+            self._eff_dev.append(
+                decomp.axis_array(mu, eff, sharded=(mu != 2)))
 
         self._transversify = jax.jit(self._transversify_impl)
         self._vec_to_pol = jax.jit(self._vec_to_pol_impl)

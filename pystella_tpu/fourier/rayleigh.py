@@ -127,7 +127,7 @@ class RayleighGenerator:
             phase = jnp.where(mag > 0, nk / jnp.where(mag > 0, mag, 1),
                               jnp.asarray(1, self.cdtype))
             return (phase * root).astype(self.cdtype)
-        return jax.jit(impl, out_shardings=self.decomp.sharding(0))(nk)
+        return jax.jit(impl, out_shardings=self.fft.k_sharding(0))(nk)
 
     def generate(self, queue=None, random=True,
                  field_ps=lambda kmag: 1 / 2 / kmag,
@@ -225,7 +225,7 @@ class RayleighGenerator:
             dfk = (wk * dfree - hubble * fk).astype(self.cdtype)
             return fk, dfk
 
-        sharding = self.decomp.sharding(0)
+        sharding = self.fft.k_sharding(0)
         return jax.jit(combine, out_shardings=(sharding, sharding))(
             fk, dfree)
 

@@ -66,8 +66,8 @@ class PowerSpectra:
         self.bin_counts = np.histogram(kmags, weights=counts, bins=bins)[0]
 
         # device-side bin indices and count weights, sharded like k-space
-        from jax.sharding import NamedSharding
-        sharding = NamedSharding(decomp.mesh, decomp.spec(0))
+        # (x/y as the decomposition, half-spectrum z axis local)
+        sharding = fft.k_sharding(0)
         bin_idx = np.round(kmags / self.bin_width).astype(np.int32)
         self._bin_idx = jax.device_put(bin_idx, sharding)
         self._counts = jax.device_put(
@@ -88,9 +88,12 @@ class PowerSpectra:
         axes batch through a single distributed bincount."""
         from pystella_tpu.ops.histogram import weighted_bincount
         if isinstance(fk, np.ndarray):
-            fk = self.decomp.shard(fk)
+            fk = self.fft.shard_k(fk)
         b, w = self._weights(fk, k_power)
-        hist = weighted_bincount(self.decomp, b, w, self.num_bins)
+        # k-space layout: x/y as the decomposition, half-spectrum z local
+        hist = weighted_bincount(self.decomp, b, w, self.num_bins,
+                                 lattice_names=tuple(
+                                     self.fft.k_sharding(0).spec))
         return np.asarray(hist) / self.bin_counts
 
     def __call__(self, fx, queue=None, k_power=3, allocator=None):

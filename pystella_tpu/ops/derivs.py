@@ -17,11 +17,15 @@ reproduced exactly.
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "FirstCenteredDifference", "SecondCenteredDifference",
@@ -154,6 +158,10 @@ class FiniteDifferencer:
             mode = "pallas" if (jax.default_backend() == "tpu"
                                 and py == 1 and pz == 1
                                 and self.h <= 8) else "halo"
+            logger.info(
+                "FiniteDifferencer(h=%d, proc_shape=%s): mode='auto' "
+                "selected the %s path on backend %s", self.h,
+                decomp.proc_shape, mode, jax.default_backend())
         if mode not in ("halo", "roll", "pallas"):
             raise ValueError(f"unknown mode {mode}")
         if mode == "pallas" and (decomp.proc_shape[1] != 1
@@ -162,6 +170,7 @@ class FiniteDifferencer:
                 "pallas mode supports sharding only along x; use halo mode")
         self.mode = mode
         self._sharded_cache = {}
+        self._pallas_infeasible = set()
 
     # -- eigenvalues (consumed by fourier/) --------------------------------
 
@@ -387,11 +396,23 @@ class FiniteDifferencer:
         lat = tuple(x.shape[-3:])
         outer = x.shape[:-3]
         n_comp = int(np.prod(outer)) if outer else 1
-        try:
-            op = self._pallas_op(name, n_comp, x.dtype, vector_in, lat)
-        except ValueError:
-            # no feasible (bx, by) blocking for this lattice (e.g. axes not
-            # divisible by any block size): fall back to the XLA halo path
+        fallback_key = (name, n_comp, str(x.dtype), vector_in, lat)
+        if fallback_key in self._pallas_infeasible:
+            op = None
+        else:
+            try:
+                op = self._pallas_op(name, n_comp, x.dtype, vector_in, lat)
+            except ValueError as err:
+                # no feasible (bx, by) blocking for this lattice (e.g. axes
+                # not divisible by any block size): fall back to the XLA
+                # halo path, warning once per (op, shape) — not per call
+                logger.warning(
+                    "pallas %s kernel infeasible for lattice %s (%s); "
+                    "falling back to the shard_map+halo XLA path for this "
+                    "operator", name, lat, err)
+                self._pallas_infeasible.add(fallback_key)
+                op = None
+        if op is None:
             n_outer = len(outer) - (1 if vector_in else 0)
             extra = name in ("grad", "grad_lap")
             return self._sharded(name, n_outer, extra, vector_in)(x)

@@ -47,23 +47,33 @@ _bincount_cache = weakref.WeakKeyDictionary()
 _CHUNK = 1 << 22
 
 
-def _bincount_fn(decomp, outer_shape, num_bins, weighted):
+def _bincount_fn(decomp, outer_shape, num_bins, weighted,
+                 lattice_names=None):
     """Build (and cache) the jitted distributed chunked bincount for a
     given decomposition / outer shape / bin count. Returns per-device,
     per-chunk partial histograms stacked along axis 0 (the host finalizes
-    in wide precision)."""
+    in wide precision). ``lattice_names`` are the per-lattice-axis mesh
+    axis names of the input layout (default: the decomposition's
+    position-space layout; k-space callers keep the half-spectrum z axis
+    local and pass its names instead)."""
+    from jax.sharding import PartitionSpec as P
+    if lattice_names is None:
+        lattice_names = tuple(decomp.spec(0))
+    lattice_names = tuple(lattice_names)
     per_decomp = _bincount_cache.setdefault(decomp, {})
-    key = (outer_shape, num_bins, weighted)
+    key = (outer_shape, num_bins, weighted, lattice_names)
     cached = per_decomp.get(key)
     if cached is not None:
         return cached
-    from jax.sharding import PartitionSpec as P
     nouter = int(np.prod(outer_shape, dtype=np.int64)) if outer_shape else 1
     length = num_bins * nouter
-    spec = decomp.spec(len(outer_shape))
+    spec = P(*((None,) * len(outer_shape) + lattice_names))
     # partials stay sharded along the stacked chunk axis — no device-side
-    # reduction, so no precision-losing f32/int32 cross-device sums
-    out_spec = P(decomp.reduce_axes or None, None)
+    # reduction, so no precision-losing f32/int32 cross-device sums;
+    # stacking covers only the axes the input is actually sharded over
+    # (mesh axes the input is replicated across would double count)
+    stack = tuple(n for n in lattice_names if n is not None)
+    out_spec = P(stack or None, None)
 
     def flat_chunked_bins(b):
         if nouter > 1:
@@ -106,22 +116,25 @@ def _bincount_fn(decomp, outer_shape, num_bins, weighted):
     return fn
 
 
-def weighted_bincount(decomp, bins, weights, num_bins):
+def weighted_bincount(decomp, bins, weights, num_bins, lattice_names=None):
     """Distributed histogram: chunked per-device ``jnp.bincount``s with
     host-side wide-precision finalization (see module docstring). ``bins``
     (int32) has shape ``outer + lattice``; ``weights`` shares it, or is
-    ``None`` for an exact integer count histogram. Returns a **host**
-    ``np.ndarray`` of shape ``outer + (num_bins,)`` (float64, or int64 for
-    counts). The shared primitive behind :class:`Histogrammer` and
+    ``None`` for an exact integer count histogram. ``lattice_names``
+    optionally overrides the assumed input layout (see
+    :func:`_bincount_fn`). Returns a **host** ``np.ndarray`` of shape
+    ``outer + (num_bins,)`` (float64, or int64 for counts). The shared
+    primitive behind :class:`Histogrammer` and
     :class:`~pystella_tpu.PowerSpectra`."""
     outer_shape = tuple(bins.shape[:-3])
     num_bins = int(num_bins)
     if weights is None:
-        partials = _bincount_fn(decomp, outer_shape, num_bins, False)(bins)
+        partials = _bincount_fn(decomp, outer_shape, num_bins, False,
+                                lattice_names)(bins)
         h = np.asarray(partials).astype(np.int64).sum(axis=0)
     else:
-        partials = _bincount_fn(decomp, outer_shape, num_bins, True)(
-            bins, weights)
+        partials = _bincount_fn(decomp, outer_shape, num_bins, True,
+                                lattice_names)(bins, weights)
         h = np.asarray(partials).astype(np.float64).sum(axis=0)
     return h.reshape(outer_shape + (num_bins,))
 

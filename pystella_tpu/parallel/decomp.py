@@ -109,15 +109,18 @@ class DomainDecomposition:
         names = self.reduce_axes
         return lax.psum(x, names) if names else x
 
-    def axis_array(self, mu, values):
+    def axis_array(self, mu, values, sharded=True):
         """Device array of per-axis constants (momenta, stencil eigenvalues)
         shaped ``(1, .., len(values), .., 1)`` for broadcasting against
-        lattice arrays, sharded to match lattice axis ``mu``."""
+        lattice arrays, sharded to match lattice axis ``mu``. Pass
+        ``sharded=False`` for axes that are local in the consuming layout
+        (e.g. the r2c half-spectrum z axis, which k-space arrays keep
+        unsharded on z-decomposed meshes)."""
         values = np.asarray(values)
         shape = [1] * len(self.axis_names)
         shape[mu] = len(values)
         spec = [None] * len(self.axis_names)
-        if self.proc_shape[mu] > 1:
+        if sharded and self.proc_shape[mu] > 1:
             spec[mu] = self.axis_names[mu]
         return jax.device_put(values.reshape(shape),
                               NamedSharding(self.mesh, P(*spec)))

@@ -121,6 +121,19 @@ class Stepper:
         # one fused XLA computation per (state structure, rhs_args structure)
         self._jit_step = jax.jit(_step_impl)
 
+    def _ensure_stage_jits(self):
+        """Per-stage executables for the reference-style driver loop
+        (scalar_preheating.py:258-266): stage index is static, so each
+        stage compiles once per (carry structure, rhs_args structure) and
+        every later call is a single cached dispatch instead of an eager
+        op-by-op walk of the stage update. Built lazily so subclasses with
+        their own ``__init__`` (fused steppers) get them too."""
+        if not hasattr(self, "_jit_stage"):
+            self._jit_stage = jax.jit(self.stage, static_argnums=0)
+            self._jit_stage0 = jax.jit(
+                lambda state, t, dt, rhs_args:
+                    self.stage(0, self.init_carry(state), t, dt, rhs_args))
+
     # -- whole-step interface ---------------------------------------------
 
     def step(self, state, t=0.0, dt=None, rhs_args=None):
@@ -135,11 +148,25 @@ class Stepper:
     def __call__(self, stage, state_or_carry, t=0.0, dt=None, **rhs_args):
         """Run stage ``stage``. At stage 0 pass the state; afterwards pass
         the returned carry. After the last stage the return value is the new
-        state."""
+        state.
+
+        Device-array states run through a cached per-stage jitted
+        executable; host-scalar states (:class:`Expansion`'s ODE) stay
+        eager so they never round-trip through the device."""
         dt = dt if dt is not None else self.dt
-        carry = (self.init_carry(state_or_carry) if stage == 0
-                 else state_or_carry)
-        carry = self.stage(stage, carry, t, dt, rhs_args)
+        on_device = any(isinstance(leaf, jax.Array) for leaf in
+                        jax.tree_util.tree_leaves(state_or_carry))
+        if on_device:
+            self._ensure_stage_jits()
+            if stage == 0:
+                carry = self._jit_stage0(state_or_carry, t, dt, rhs_args)
+            else:
+                carry = self._jit_stage(stage, state_or_carry, t, dt,
+                                        rhs_args)
+        else:
+            carry = (self.init_carry(state_or_carry) if stage == 0
+                     else state_or_carry)
+            carry = self.stage(stage, carry, t, dt, rhs_args)
         if stage == self.num_stages - 1:
             return self.extract(carry)
         return carry

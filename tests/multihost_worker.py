@@ -1,0 +1,105 @@
+"""Worker for the real two-process distributed test (test_multihost.py).
+
+Each of two OS processes runs this script (the analog of one MPI rank under
+the reference's ``mpirun -np 2`` CI jobs,
+/root/reference/.github/workflows/ci.yml:96-97). The processes form a JAX
+multi-controller cluster over a localhost coordinator, each contributing two
+virtual CPU devices, and exercise the multihost verbs end to end:
+
+- ``host_local_to_global`` / ``global_to_host_local`` round-trip,
+- a sharded halo-exchange stencil (``lax.ppermute`` crossing the process
+  boundary) against a direct numpy stencil,
+- the pencil DFT over the 2-host mesh against ``np.fft.rfftn``,
+- a lattice-wide reduction and ``sync_hosts``.
+
+Usage: ``python multihost_worker.py <coordinator_addr> <process_id>``.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "1"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def main():
+    coordinator, process_id = sys.argv[1], int(sys.argv[2])
+
+    import numpy as np
+    import pystella_tpu as ps
+    from pystella_tpu.parallel import multihost as mh
+
+    mh.init_multihost(coordinator_address=coordinator, num_processes=2,
+                      process_id=process_id)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(mh.global_devices()) == 4
+    assert len(jax.local_devices()) == 2
+
+    grid_shape = (16, 8, 8)
+    h = 2
+    decomp = ps.DomainDecomposition((4, 1, 1), devices=mh.global_devices())
+
+    # every process builds the same global lattice (same seed), like the
+    # reference's halo test (test_decomp.py:47-103)
+    rng = np.random.default_rng(42)
+    full = rng.random(grid_shape)
+
+    # -- host_local_to_global -> global_to_host_local round-trip -----------
+    # process p owns the x-slab covered by its two local devices
+    nx_host = grid_shape[0] // 2
+    my_block = full[process_id * nx_host:(process_id + 1) * nx_host]
+    global_arr = mh.host_local_to_global(decomp, my_block)
+    assert global_arr.shape == grid_shape
+
+    back = mh.global_to_host_local(decomp, global_arr)
+    np.testing.assert_array_equal(np.asarray(back), my_block)
+
+    # -- halo-exchange stencil across the process boundary ------------------
+    fd = ps.FiniteDifferencer(decomp, h, (1.0, 1.0, 1.0), mode="halo")
+    lap_local = np.asarray(
+        mh.global_to_host_local(decomp, fd.lap(global_arr)))
+
+    ref = np.zeros_like(full)
+    for d in range(3):
+        for s, c in fd.second.coefs.items():
+            if s == 0:
+                ref += c * full
+            else:
+                ref += c * (np.roll(full, -s, axis=d)
+                            + np.roll(full, s, axis=d))
+    np.testing.assert_allclose(
+        lap_local, ref[process_id * nx_host:(process_id + 1) * nx_host],
+        atol=1e-12)
+
+    # -- distributed pencil FFT over the 2-host mesh ------------------------
+    fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
+    fk = fft.dft(global_arr)
+    fk_local = np.asarray(mh.global_to_host_local(decomp, fk))
+    ref_k = np.fft.rfftn(full)
+    np.testing.assert_allclose(
+        fk_local, ref_k[process_id * nx_host:(process_id + 1) * nx_host],
+        atol=1e-9)
+
+    roundtrip = mh.global_to_host_local(decomp, fft.idft(fk))
+    np.testing.assert_allclose(np.asarray(roundtrip), my_block, atol=1e-12)
+
+    # -- lattice-wide reduction (replicated result) + barrier ---------------
+    total = jax.jit(lambda x: x.sum())(global_arr)
+    np.testing.assert_allclose(float(total), full.sum(), rtol=1e-13)
+
+    mh.sync_hosts("test-done")
+    print(f"worker {process_id}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -65,11 +65,39 @@ def test_zero_corner_modes(decomp2d, proc_shape):
     assert out[1, 2, 3] == fk[1, 2, 3]
 
 
-def test_z_decomposition_rejected():
-    import jax
-    decomp = ps.DomainDecomposition((1, 1, 2), devices=jax.devices()[:2])
-    with pytest.raises(ValueError, match="undecomposed z"):
-        ps.DFT(decomp, grid_shape=(8, 8, 8), dtype=np.float64)
+@pytest.mark.parametrize("proc_shape", [(1, 1, 2), (2, 1, 2), (2, 2, 2)],
+                         indirect=True)
+def test_z_decomposition_roundtrip(decomp, grid_shape, proc_shape):
+    """z-sharded meshes take the general pencil path (the transform starts
+    by making z local; the reference forbids z decomposition entirely,
+    decomp.py:129-130)."""
+    fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
+    rng = np.random.default_rng(7)
+    fx = rng.random(grid_shape)
+
+    fk = fft.dft(decomp.shard(fx))
+    assert np.allclose(np.asarray(fk), np.fft.rfftn(fx), atol=1e-10)
+    back = fft.idft(fk)
+    assert np.allclose(np.asarray(back), fx, atol=1e-12)
+
+
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+def test_replicate_fallback_when_pencils_infeasible(decomp, proc_shape,
+                                                    caplog):
+    """Grids divisible per mesh axis but not by the total device count
+    replicate-transform (correct, warned once at construction)."""
+    import logging
+    grid_shape = (6, 6, 8)  # 6 % 2 == 0 (shardable) but 6 % 4 != 0
+    with caplog.at_level(logging.WARNING, "pystella_tpu.fourier.dft"):
+        fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
+    assert not fft._pencil_ok
+    assert any("REPLICATE" in r.message for r in caplog.records)
+
+    rng = np.random.default_rng(8)
+    fx = rng.random(grid_shape)
+    fk = fft.dft(decomp.shard(fx))
+    assert np.allclose(np.asarray(fk), np.fft.rfftn(fx), atol=1e-10)
+    assert np.allclose(np.asarray(fft.idft(fk)), fx, atol=1e-12)
 
 
 def test_make_hermitian_enforces_symmetry():

@@ -9,7 +9,7 @@ import pystella_tpu as ps
 
 @pytest.fixture
 def setup(proc_shape, grid_shape, make_decomp):
-    decomp = make_decomp((proc_shape[0], proc_shape[1], 1))
+    decomp = make_decomp(proc_shape)
     lattice = ps.Lattice(grid_shape, (5.0, 5.0, 5.0), dtype=np.float64)
     fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
     spectra = ps.PowerSpectra(decomp, fft, lattice.dk, lattice.volume)
@@ -38,7 +38,8 @@ def numpy_spectrum(fx, dk, volume, bin_width, num_bins, k_power=3):
     return norm * hist / bin_counts
 
 
-@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1), (2, 2, 2)],
+                         indirect=True)
 @pytest.mark.parametrize("k_power", [3, 0])
 def test_spectra_match_numpy(setup, grid_shape, proc_shape, k_power):
     decomp, lattice, fft, spectra = setup
