@@ -404,6 +404,7 @@ def main():
 
     got_tpu = 0
     attempt = 0
+    fast_failures = 0
     while not force_cpu:
         remaining = total_budget - cpu_reserve - (time.time() - T0)
         if remaining < 120:
@@ -412,6 +413,7 @@ def main():
         attempt += 1
         hb(f"orchestrator: TPU payload attempt {attempt} "
            f"({remaining:.0f}s of TPU budget left)")
+        t_attempt = time.time()
         relayed, rc = run_payload("tpu", remaining)
         got_tpu += relayed
         if relayed and rc == 0:
@@ -426,6 +428,17 @@ def main():
             hb("orchestrator: device up but all configs failed (rc=3); "
                "not retrying")
             break
+        # deterministic fast failures (rc=4 plugin misconfig, rc=1 crash)
+        # would otherwise burn the whole TPU budget in a tight retry loop;
+        # only slow dial timeouts are worth retrying indefinitely
+        if time.time() - t_attempt < 120:
+            fast_failures += 1
+            if fast_failures >= 3:
+                hb(f"orchestrator: {fast_failures} consecutive fast "
+                   f"failures (last rc={rc}); giving up on TPU")
+                break
+        else:
+            fast_failures = 0
         hb(f"orchestrator: attempt {attempt} produced no results "
            f"(rc={rc}); retrying" if rc is not None else
            f"orchestrator: attempt {attempt} timed out mid-dial; retrying")

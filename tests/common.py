@@ -1,0 +1,83 @@
+"""Shared harness for running test files as benchmark scripts.
+
+Mirrors /root/reference/test/common.py:41-76: every operator test file has
+a ``__main__`` block that doubles as a per-kernel microbenchmark via
+:func:`pystella_tpu.timer`, parametrized by the same ``--grid_shape`` /
+``--proc_shape`` CLI the pytest suite uses. Run e.g.::
+
+    python tests/test_derivs.py -grid 256 256 256 --h 2
+
+On import (before jax initializes a backend) this configures the platform:
+CPU with 8 virtual devices by default — the container may globally set
+``JAX_PLATFORMS`` to the remote-TPU plugin, so CPU is forced unless the
+caller explicitly opts into hardware with ``PYSTELLA_BENCH_PLATFORM=tpu``
+(the plugin is then left registered and the dial may take minutes).
+Importing is idempotent, so pytest runs (where ``conftest.py`` already did
+the same dance) are unaffected.
+"""
+
+import argparse
+import os
+
+os.environ["JAX_ENABLE_X64"] = "1"
+_cpu = os.environ.get("PYSTELLA_BENCH_PLATFORM", "cpu") == "cpu"
+if _cpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = \
+            _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+if _cpu:
+    # The container's sitecustomize registers a remote-TPU ("axon") PJRT
+    # plugin at interpreter startup; merely querying jax.devices() would
+    # try to claim the tunnel even under JAX_PLATFORMS=cpu. Pop only the
+    # axon factory: removing the standard "tpu" factory would deregister
+    # the platform and break jax.experimental.pallas imports (checkify
+    # registers a tpu lowering rule at import time).
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # reference defaults to float64
+
+import numpy as np  # noqa: E402
+
+
+parser = argparse.ArgumentParser(add_help=False)
+parser.add_argument("--help", action="help")
+parser.add_argument("-proc", "--proc_shape", type=int, nargs=3,
+                    default=(1, 1, 1))
+parser.add_argument("-grid", "--grid_shape", type=int, nargs=3,
+                    default=(128, 128, 128))
+parser.add_argument("--h", type=int, default=2, metavar="h")
+parser.add_argument("--dtype", type=np.dtype, default=np.float64)
+parser.add_argument("--ntime", type=int, default=50)
+
+
+def parse_args(argv=None):
+    args = parser.parse_args(argv)
+    args.proc_shape = tuple(args.proc_shape)
+    args.grid_shape = tuple(args.grid_shape)
+    return args
+
+
+def script_decomp(proc_shape):
+    import pystella_tpu as ps
+    n = int(np.prod(proc_shape))
+    if n > len(jax.devices()):
+        raise SystemExit(
+            f"mesh {proc_shape} needs {n} devices, have {len(jax.devices())}")
+    return ps.DomainDecomposition(proc_shape, devices=jax.devices()[:n])
+
+
+def report(name, ms, nbytes=None, nsites=None):
+    """Print one benchmark line: ms/call, optional GB/s and sites/s."""
+    extra = ""
+    if nbytes is not None:
+        extra += f"  {nbytes / ms / 1e6:8.1f} GB/s"
+    if nsites is not None:
+        extra += f"  {nsites / ms * 1e3:.3e} sites/s"
+    print(f"{name:<28s} {ms:8.3f} ms{extra}")

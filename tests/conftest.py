@@ -7,34 +7,17 @@ process-grid shapes (/root/reference/test/conftest.py:1-22 +
 devices via ``--xla_force_host_platform_device_count`` and tests
 parametrize over mesh shapes, exercising the identical ``shard_map`` /
 ``ppermute`` / ``psum`` code paths that run over ICI on a real TPU slice.
+
+The platform-forcing dance itself (CPU backend, virtual devices, dropping
+the remote-TPU plugin before any backend query) lives in ``common.py``,
+shared with the test files' ``__main__`` benchmark scripts.
 """
 
 import os
 
-# must run before jax initializes a backend
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["JAX_ENABLE_X64"] = "1"  # reference defaults to float64 accuracy
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip TPU-tunnel plugin
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = \
-        _flags + " --xla_force_host_platform_device_count=8"
+os.environ["PYSTELLA_BENCH_PLATFORM"] = "cpu"  # the suite always runs CPU
 
-import jax  # noqa: E402
-
-# The container's sitecustomize registers a remote-TPU ("axon") PJRT plugin
-# at interpreter startup; merely querying jax.devices() would try to claim
-# the tunnel even under JAX_PLATFORMS=cpu. Tests run on the virtual CPU
-# mesh, so drop the factory before any backend is initialized.
-from jax._src import xla_bridge as _xb  # noqa: E402
-
-# pop only the axon plugin: removing the standard "tpu" factory would
-# deregister the platform and break jax.experimental.pallas imports
-# (checkify registers a tpu lowering rule at import time)
-_xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)  # reference defaults to float64
-
+import common  # noqa: F401, E402  (side effect: forces the CPU backend)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -71,3 +71,38 @@ def test_zeros_sharded(decomp, grid_shape):
     arr = decomp.zeros(grid_shape, np.float32, outer_shape=(2,))
     assert arr.shape == (2,) + grid_shape
     assert float(arr.sum()) == 0.0
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64,
+                                   np.complex64, np.complex128])
+@pytest.mark.parametrize("outer_shape", [(), (2,)])
+def test_gather_scatter_dtype_combinations(decomp, grid_shape, dtype,
+                                           outer_shape):
+    """Analog of the reference's gather/scatter type-combination matrix
+    (/root/reference/test/test_decomp.py:108-173, which cycles
+    cl.Array/np.ndarray sources and targets per dtype): host->device->host
+    round-trips must be exact for every dtype, with and without outer
+    axes, from both host and device sources."""
+    rng = np.random.default_rng(31)
+    shape = outer_shape + tuple(grid_shape)
+    data = rng.random(shape).astype(dtype)
+    if np.dtype(dtype).kind == "c":
+        data = data + 1j * rng.random(shape).astype(dtype)
+
+    # host ndarray -> sharded device array (reference scatter_array)
+    arr = decomp.shard(data)
+    assert arr.dtype == np.dtype(dtype)
+    assert arr.shape == shape
+
+    # device -> host (reference gather_array)
+    back = decomp.gather_array(arr)
+    assert isinstance(back, np.ndarray)
+    np.testing.assert_array_equal(back, data)
+
+    # device array source re-placed (reference cl.Array -> cl.Array)
+    arr2 = decomp.shard(arr)
+    np.testing.assert_array_equal(decomp.gather_array(arr2), data)
+
+    # reference-API alias
+    arr3 = decomp.scatter_array(data)
+    np.testing.assert_array_equal(decomp.gather_array(arr3), data)

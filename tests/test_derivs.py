@@ -118,3 +118,33 @@ def test_roll_mode_matches_halo_mode(decomp, grid_shape, proc_shape):
                        np.asarray(fd_roll.lap(arr)), atol=1e-12)
     assert np.allclose(np.asarray(fd_halo.grad(arr)),
                        np.asarray(fd_roll.grad(arr)), atol=1e-12)
+
+
+if __name__ == "__main__":
+    # per-kernel microbenchmark (reference test/common.py:41-56 pattern):
+    #   python tests/test_derivs.py -grid 256 256 256 --h 2
+    import common
+
+    args = common.parse_args()
+    decomp = common.script_decomp(args.proc_shape)
+    lattice = ps.Lattice(args.grid_shape, (5.0,) * 3, dtype=args.dtype)
+    fd = ps.FiniteDifferencer(decomp, args.h, lattice.dx)
+
+    rng = np.random.default_rng(1)
+    arr = decomp.shard(rng.standard_normal(args.grid_shape).astype(args.dtype))
+    vec = decomp.shard(np.stack([np.asarray(arr)] * 3))
+    nsites = float(np.prod(args.grid_shape))
+    isize = np.dtype(args.dtype).itemsize
+
+    print(f"grid={args.grid_shape} proc={args.proc_shape} h={args.h} "
+          f"dtype={args.dtype} mode={fd.mode}")
+    # (thunk, arrays moved: inputs read + outputs written)
+    for name, thunk, narrays in [
+            ("lap", lambda: fd.lap(arr), 2),
+            ("grad", lambda: fd.grad(arr), 4),
+            ("grad_lap", lambda: fd.grad_lap(arr), 5),
+            ("pdx", lambda: fd.pdx(arr), 2),
+            ("div", lambda: fd.divergence(vec), 4)]:
+        ms = ps.timer(thunk, ntime=args.ntime)
+        common.report(name, ms, nbytes=narrays * nsites * isize,
+                      nsites=nsites)

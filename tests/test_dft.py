@@ -116,3 +116,23 @@ def test_make_hermitian_enforces_symmetry():
         for j in (0, 4):
             for k in (0, 4):
                 assert fk[i, j, k].imag == 0
+
+
+if __name__ == "__main__":
+    # transform microbenchmark (reference test/common.py:41-56 pattern):
+    #   python tests/test_dft.py -grid 256 256 256
+    import common
+
+    args = common.parse_args()
+    decomp = common.script_decomp(args.proc_shape)
+    fft = ps.DFT(decomp, grid_shape=args.grid_shape, dtype=args.dtype)
+
+    rng = np.random.default_rng(2)
+    fx = decomp.shard(rng.standard_normal(args.grid_shape).astype(args.dtype))
+    fk = fft.dft(fx)
+
+    nsites = float(np.prod(args.grid_shape))
+    common.report("dft (r2c)", ps.timer(lambda: fft.dft(fx),
+                                        ntime=args.ntime), nsites=nsites)
+    common.report("idft", ps.timer(lambda: fft.idft(fk),
+                                   ntime=args.ntime), nsites=nsites)

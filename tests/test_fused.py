@@ -178,3 +178,32 @@ def test_fused_preheat_sharded_x_matches_single():
     for name in state_h:
         assert np.allclose(np.asarray(got[name]), np.asarray(ref[name]),
                            rtol=1e-12, atol=1e-13), name
+
+
+if __name__ == "__main__":
+    # fused-stage microbenchmark (reference test/common.py:41-56 pattern):
+    #   python tests/test_fused.py -grid 128 128 128
+    import common
+
+    args = common.parse_args()
+    decomp = common.script_decomp(args.proc_shape)
+    dx = tuple(5.0 / n for n in args.grid_shape)
+    dt = 0.1 * min(dx)
+
+    sector = ps.ScalarSector(2, potential=_potential)
+    fused = FusedScalarStepper(sector, decomp, args.grid_shape, dx,
+                               args.h, dtype=args.dtype, dt=dt)
+    rng = np.random.default_rng(5)
+    state = {k: decomp.shard(
+        0.1 * rng.standard_normal((2,) + args.grid_shape).astype(args.dtype))
+        for k in ("f", "dfdt")}  # noqa: E501
+    rhs_args = {"a": np.dtype(args.dtype).type(1.0),
+                "hubble": np.dtype(args.dtype).type(0.1)}
+
+    nsites = float(np.prod(args.grid_shape))
+    isize = np.dtype(args.dtype).itemsize
+    ms = ps.timer(lambda: fused.step(state, 0.0, dt, rhs_args),
+                  ntime=args.ntime)
+    # 8 lattice arrays moved per stage (f,dfdt,kf,kdfdt r+w) x 2 fields
+    common.report("fused RK54 step", ms,
+                  nbytes=8 * 5 * 2 * nsites * isize, nsites=nsites)

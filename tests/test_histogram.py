@@ -104,3 +104,20 @@ def test_reduction_requires_lattice_arg(decomp):
     red = ps.Reduction(decomp, {"e": [(ps.Field("f"), "avg")]})
     with pytest.raises(ValueError, match="lattice"):
         red(f=np.float64(3.0))
+
+
+if __name__ == "__main__":
+    # binning microbenchmark (reference test/common.py:41-56 pattern):
+    #   python tests/test_histogram.py -grid 256 256 256
+    import common
+
+    args = common.parse_args()
+    decomp = common.script_decomp(args.proc_shape)
+    rng = np.random.default_rng(3)
+    fx = decomp.shard(rng.standard_normal(args.grid_shape))
+
+    hister = ps.FieldHistogrammer(decomp, num_bins=64, dtype=np.float64)
+    nsites = float(np.prod(args.grid_shape))
+    common.report("field histogram (lin+log)",
+                  ps.timer(lambda: hister(fx), ntime=args.ntime),
+                  nsites=nsites)

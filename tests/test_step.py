@@ -98,3 +98,83 @@ def test_array_state(decomp, grid_shape):
     expected = (y0 ** -1 - t) ** -1
     # tolerance set by RK truncation error, not roundoff
     assert np.allclose(np.asarray(state["y"]), expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
+def test_low_storage_edge_state_shapes(decomp, grid_shape, proc_shape):
+    """Analog of the reference's exotic rhs_dict / tmp-array allocation
+    test (/root/reference/test/test_step.py:102-182). There the low-storage
+    stepper must allocate one persistent ``_y_tmp`` per unknown with
+    matching shape/dtype; here the auxiliary is the functional carry from
+    ``init_carry``, which must mirror the state's pytree (shapes, dtypes,
+    complex and multi-outer-axis entries included), and stepping
+    ``y' = 1`` must advance every entry by exactly dt."""
+    import jax.numpy as jnp
+
+    dt = 0.1
+
+    # complex-dtype lattice unknown (reference: cla.zeros complex128)
+    y = decomp.zeros(grid_shape, np.complex128)
+    stepper = ps.LowStorageRK54({ps.Field("y"): 1}, dt=dt)
+    carry = stepper.init_carry({"y": y})
+    assert carry[1]["y"].shape == y.shape
+    assert carry[1]["y"].dtype == y.dtype
+    out = stepper.step({"y": y}, 0.0, dt)
+    assert np.allclose(np.asarray(out["y"]), dt, atol=1e-14)
+
+    # (2, 2) outer axes (reference: shape (2, 2) Field over a 12^3 grid)
+    y22 = decomp.zeros(grid_shape, np.float64, outer_shape=(2, 2))
+    out = stepper.step({"y": y22}, 0.0, dt)
+    assert out["y"].shape == y22.shape
+    assert np.allclose(np.asarray(out["y"]), dt, atol=1e-14)
+
+    # mixed-dtype state dict (reference: y float64 + z complex128)
+    stepper = ps.LowStorageRK54({ps.Field("y"): 1, ps.Field("z"): 1}, dt=dt)
+    state = {"y": decomp.zeros(grid_shape, np.float64),
+             "z": decomp.zeros(grid_shape, np.complex128)}
+    carry = stepper.init_carry(state)
+    for name in state:
+        assert carry[1][name].shape == state[name].shape
+        assert carry[1][name].dtype == state[name].dtype
+    out = stepper.step(state, 0.0, dt)
+    assert np.allclose(np.asarray(out["y"]), dt, atol=1e-14)
+    assert np.allclose(np.asarray(out["z"]), dt, atol=1e-14)
+
+    # scalar (0-d) unknown alongside a lattice unknown in one state
+    def rhs(s, t):
+        return {"y": jnp.ones_like(s["y"]), "c": 1.0}
+
+    stepper = ps.LowStorageRK54(rhs, dt=dt)
+    state = {"y": decomp.zeros(grid_shape, np.float64),
+             "c": jnp.float64(0.0)}
+    out = stepper.step(state, 0.0, dt)
+    assert np.allclose(np.asarray(out["y"]), dt, atol=1e-14)
+    assert np.isclose(float(out["c"]), dt, atol=1e-14)
+
+
+if __name__ == "__main__":
+    # whole-step microbenchmark of the generic (non-fused) stepper:
+    #   python tests/test_step.py -grid 128 128 128
+    import common
+
+    args = common.parse_args()
+    decomp = common.script_decomp(args.proc_shape)
+    lattice = ps.Lattice(args.grid_shape, (5.0,) * 3, dtype=args.dtype)
+    fd = ps.FiniteDifferencer(decomp, args.h, lattice.dx)
+    dt = 0.1 * min(lattice.dx)
+
+    def rhs(state, t):
+        return {"f": state["dfdt"], "dfdt": fd.lap(state["f"])}
+
+    rng = np.random.default_rng(4)
+    state = {
+        "f": decomp.shard(
+            rng.standard_normal(args.grid_shape).astype(args.dtype)),
+        "dfdt": decomp.zeros(args.grid_shape, args.dtype)}
+    nsites = float(np.prod(args.grid_shape))
+    for cls in (ps.LowStorageRK54, ps.RungeKutta4,
+                ps.LowStorageRK3Williamson):
+        stepper = cls(rhs, dt=dt)
+        ms = ps.timer(lambda s=stepper: s.step(state, 0.0, dt),
+                      ntime=args.ntime)
+        common.report(cls.__name__, ms, nsites=nsites)
