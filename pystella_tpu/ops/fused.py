@@ -91,6 +91,17 @@ class FusedScalarStepper(_step.Stepper):
         self._dvdf = [_field.diff(V, f[i]) for i in range(F)]
 
         self.local_shape = decomp.rank_shape(self.grid_shape)
+        self._build_kernels(bx, by)
+
+        # jitted whole-step (one XLA computation, all stages fused)
+        import jax
+        self._jit_step = jax.jit(self._step_impl)
+
+    def _build_kernels(self, bx, by):
+        """Construct this stepper's stage kernel(s). Subclasses override to
+        build their own fused kernel instead (so they don't pay for — or
+        keep alive — a scalar-only kernel they never call)."""
+        F = self.F
         self._scalar_st = StreamingStencil(
             self.local_shape, {"f": F}, self.h,
             self._scalar_body, out_defs={
@@ -101,10 +112,6 @@ class FusedScalarStepper(_step.Stepper):
         self._scalar_call = self._make_call(
             self._scalar_st, windows=("f",),
             extra_names=("dfdt", "kf", "kdfdt"))
-
-        # jitted whole-step (one XLA computation, all stages fused)
-        import jax
-        self._jit_step = jax.jit(self._step_impl)
 
     def _make_call(self, st, windows, extra_names):
         """Wrap a StreamingStencil in the sharded-x ``shard_map`` (padding
@@ -221,10 +228,13 @@ class FusedPreheatStepper(FusedScalarStepper):
     """Fused stages for the full preheating system: scalar fields plus
     transverse metric perturbations sourced by their anisotropic stress.
 
-    Each stage runs two Pallas kernels: the scalar-system kernel (inherited)
-    and a tensor kernel whose window covers both ``f`` (for the gradient
-    source terms) and ``hij``. The coupling is one-way (f → hij), so kernel
-    order within a stage is irrelevant; both read the stage-entry ``f``.
+    Each stage is **one** Pallas kernel whose window covers both ``f`` and
+    ``hij``: the scalar Laplacian, the gradient source terms, and the
+    tensor Laplacian all come from the same VMEM ring, so the ``f`` window
+    streams from HBM exactly once per stage (an earlier two-kernel design
+    re-read it for the tensor source — ~1.5x the minimum traffic for the
+    GW system). The f → hij coupling is one-way and uses the stage-entry
+    ``f``, which is exactly what the shared window holds.
 
     :arg gw_sector: a :class:`~pystella_tpu.TensorPerturbationSector`.
     """
@@ -232,9 +242,7 @@ class FusedPreheatStepper(FusedScalarStepper):
     def __init__(self, sector, gw_sector, decomp, grid_shape, dx,
                  halo_shape=2, tableau=None, dtype=jnp.float32,
                  bx=None, by=None, dt=None, **kwargs):
-        super().__init__(sector, decomp, grid_shape, dx,
-                         halo_shape=halo_shape, tableau=tableau,
-                         dtype=dtype, bx=bx, by=by, dt=dt, **kwargs)
+        # set before super().__init__, which calls _build_kernels()
         self.gw_sector = gw_sector
         self.n_hij = gw_sector.hij.shape[0]
 
@@ -248,24 +256,33 @@ class FusedPreheatStepper(FusedScalarStepper):
                     sec.stress_tensor(i, j, drop_trace=True)
                     for sec in gw_sector.sectors)
 
-        self._tensor_st = StreamingStencil(
-            self.local_shape, {"f": self.F, "hij": self.n_hij}, self.h,
-            self._tensor_body, out_defs={
-                "hij": (self.n_hij,), "dhijdt": (self.n_hij,),
-                "khij": (self.n_hij,), "kdhijdt": (self.n_hij,)},
-            extra_defs={"dhijdt": (self.n_hij,), "khij": (self.n_hij,),
-                        "kdhijdt": (self.n_hij,)},
+        super().__init__(sector, decomp, grid_shape, dx,
+                         halo_shape=halo_shape, tableau=tableau,
+                         dtype=dtype, bx=bx, by=by, dt=dt, **kwargs)
+
+    def _build_kernels(self, bx, by):
+        F, H = self.F, self.n_hij
+        self._both_st = StreamingStencil(
+            self.local_shape, {"f": F, "hij": H}, self.h,
+            self._preheat_body, out_defs={
+                "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+                "hij": (H,), "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
+            extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+                        "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
             scalar_names=("dt", "a", "hubble", "A", "B"),
             dtype=self.dtype, bx=bx, by=by, x_halo=(self._px > 1))
-        self._tensor_call = self._make_call(
-            self._tensor_st, windows=("f", "hij"),
-            extra_names=("dhijdt", "khij", "kdhijdt"))
+        self._both_call = self._make_call(
+            self._both_st, windows=("f", "hij"),
+            extra_names=("dfdt", "kf", "kdfdt",
+                         "dhijdt", "khij", "kdhijdt"))
 
-        import jax
-        self._jit_step = jax.jit(self._step_impl)
-
-    def _tensor_body(self, taps, extras, scalars):
+    def _preheat_body(self, taps, extras, scalars):
         ftaps, htaps = taps["f"], taps["hij"]
+
+        # scalar-system update from the shared f window (inherited body)
+        souts = self._scalar_body(
+            ftaps, {n: extras[n] for n in ("dfdt", "kf", "kdfdt")}, scalars)
+
         inv_dx2 = [1.0 / d**2 for d in self.dx]
         inv_dx = [1.0 / d for d in self.dx]
         lap_coefs = _lap_coefs[self.h]
@@ -293,20 +310,19 @@ class FusedPreheatStepper(FusedScalarStepper):
         h2 = hint + B * kh2
         kdh2 = A * kdh + dt * rhs_dh
         dh2 = dh + B * kdh2
-        return {"hij": h2, "dhijdt": dh2, "khij": kh2, "kdhijdt": kdh2}
+        return {**souts,
+                "hij": h2, "dhijdt": dh2, "khij": kh2, "kdhijdt": kdh2}
 
     def stage(self, s, carry, t, dt, rhs_args):
         state, k = carry
-        scalars = self._stage_scalars(s, dt, rhs_args)
-        souts = self._scalar_call(
-            {"f": state["f"]}, scalars,
-            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"]})
-        touts = self._tensor_call(
-            {"f": state["f"], "hij": state["hij"]}, scalars,
-            {"dhijdt": state["dhijdt"], "khij": k["hij"],
+        outs = self._both_call(
+            {"f": state["f"], "hij": state["hij"]},
+            self._stage_scalars(s, dt, rhs_args),
+            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"],
+             "dhijdt": state["dhijdt"], "khij": k["hij"],
              "kdhijdt": k["dhijdt"]})
-        new_state = {"f": souts["f"], "dfdt": souts["dfdt"],
-                     "hij": touts["hij"], "dhijdt": touts["dhijdt"]}
-        new_k = {"f": souts["kf"], "dfdt": souts["kdfdt"],
-                 "hij": touts["khij"], "dhijdt": touts["kdhijdt"]}
+        new_state = {"f": outs["f"], "dfdt": outs["dfdt"],
+                     "hij": outs["hij"], "dhijdt": outs["dhijdt"]}
+        new_k = {"f": outs["kf"], "dfdt": outs["kdfdt"],
+                 "hij": outs["khij"], "dhijdt": outs["kdhijdt"]}
         return (new_state, new_k)

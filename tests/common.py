@@ -61,6 +61,7 @@ def parse_args(argv=None):
     args = parser.parse_args(argv)
     args.proc_shape = tuple(args.proc_shape)
     args.grid_shape = tuple(args.grid_shape)
+    args.dtype = np.dtype(args.dtype)  # normalize the non-CLI default too
     return args
 
 
