@@ -166,14 +166,6 @@ class DFT:
                            and self.grid_shape[1] % nproc == 0)
         self._nproc = nproc
         self._z_sharded = decomp.proc_shape[2] > 1
-        if self._z_sharded and self._pencil_ok:
-            logger.info(
-                "DFT %s on a z-sharded mesh %s: the 3-D->x-pencil reshard "
-                "may be lowered inefficiently by the current XLA SPMD "
-                "partitioner (it can replicate the operand; see the XLA "
-                "'involuntary full rematerialization' warning if emitted). "
-                "x/y-only meshes take the tuned pencil path.",
-                self.grid_shape, decomp.proc_shape)
         if nproc > 1 and not self._pencil_ok:
             logger.warning(
                 "DFT %s on %d devices: grid x/y axes do not divide the "
@@ -222,17 +214,32 @@ class DFT:
     # ICI, the role mpi4py-fft's explicit MPI transposes play in the
     # reference (dft.py:391-417).
 
+    def _names(self):
+        """Per-lattice-axis mesh axis names (None for size-1 axes)."""
+        decomp = self.decomp
+        return [n if decomp.proc_shape[i] > 1 else None
+                for i, n in enumerate(decomp.axis_names)]
+
     def _specs(self, outer):
         from jax.sharding import PartitionSpec as P
-        decomp = self.decomp
-        names = [n if decomp.proc_shape[i] > 1 else None
-                 for i, n in enumerate(decomp.axis_names)]
+        names = self._names()
         mixed = tuple(n for n in names if n is not None)
         o = (None,) * outer
         return (P(*o, names[0], names[1], names[2]),   # position-space home
                 P(*o, names[0], names[1], None),       # k-space home, z local
                 P(*o, mixed or None, None, None),      # x sharded, y/z local
                 P(*o, None, mixed or None, None))      # y sharded, x/z local
+
+    def _mid_spec(self, outer):
+        """Staging layout for z-sharded meshes: z local, z's mesh devices
+        spread onto the y axis. Every transition home <-> mid <-> pencil is
+        one the SPMD partitioner lowers as collectives; the direct
+        home -> x-pencil jump triggers its involuntary-full-rematerialization
+        fallback (replicate-then-repartition)."""
+        from jax.sharding import PartitionSpec as P
+        names = self._names()
+        yz = tuple(n for n in names[1:] if n is not None)
+        return P(*((None,) * outer), names[0], yz or None, None)
 
     def _dft_impl(self, fx):
         from jax.sharding import reshard
@@ -248,15 +255,19 @@ class DFT:
                 xk, axes=(-3, -2, -1))
             return reshard(xk, khome)
         if self._z_sharded:
-            # make z local before the first axis transform
-            xk = reshard(fx, x_shard)
+            # make z local first (staged: home -> mid -> pencils, each a
+            # partitioner-friendly transition — see _mid_spec)
+            xk = reshard(fx, self._mid_spec(outer))
             xk = (jnp.fft.rfft if self.is_real else jnp.fft.fft)(xk, axis=-1)
+            xk = reshard(xk, x_shard)
         else:
             xk = (jnp.fft.rfft if self.is_real else jnp.fft.fft)(fx, axis=-1)
             xk = reshard(xk, x_shard)
         xk = jnp.fft.fft(xk, axis=-2)
         xk = reshard(xk, y_shard)
         xk = jnp.fft.fft(xk, axis=-3)
+        if self._z_sharded:
+            xk = reshard(xk, self._mid_spec(outer))
         return reshard(xk, khome)
 
     def _idft_impl(self, fk):
@@ -275,16 +286,22 @@ class DFT:
             else:
                 xk = jnp.fft.ifftn(xk, axes=(-3, -2, -1))
             return reshard(xk, phome)
-        xk = reshard(fk, y_shard)
+        if self._z_sharded:
+            xk = reshard(fk, self._mid_spec(outer))
+            xk = reshard(xk, y_shard)
+        else:
+            xk = reshard(fk, y_shard)
         xk = jnp.fft.ifft(xk, axis=-3)
         xk = reshard(xk, x_shard)
         xk = jnp.fft.ifft(xk, axis=-2)
         if self._z_sharded:
             # finish the z transform while z is still local, then go home
+            # (staged again: pencil -> mid -> home)
             if self.is_real:
                 xk = jnp.fft.irfft(xk, n=self.grid_shape[-1], axis=-1)
             else:
                 xk = jnp.fft.ifft(xk, axis=-1)
+            xk = reshard(xk, self._mid_spec(outer))
             return reshard(xk, phome)
         xk = reshard(xk, khome)
         if self.is_real:
