@@ -61,6 +61,12 @@ class RayleighGenerator:
         # the same physical field the reference produces
         self.grid_size = float(np.prod(fft.grid_shape))
         self.key = jax.random.key(seed)
+        # cached jitted executables (built on first use): noise draw,
+        # mode scaling per random-flag, WKB combine — so repeated field
+        # initializations dispatch instead of re-tracing
+        self._noise_fn = None
+        self._scale_fns = {}
+        self._wkb_combine = None
 
     @property
     def kmags(self):
@@ -100,34 +106,46 @@ class RayleighGenerator:
         """Fourier modes of a unit white-noise lattice: complex Gaussian
         with ``E|n_k|^2 = grid_size``, uniform phases, and (for real
         ``dtype``) exact Hermitian symmetry by construction."""
-        shape = self.fft.grid_shape
-        sharding = self.decomp.sharding(0)
-        if self.fft.is_real:
-            noise = jax.jit(
-                lambda k: jax.random.normal(k, shape, self.rdtype),
-                out_shardings=sharding)(key)
-        else:
-            noise = jax.jit(
-                lambda k: (lambda u: (u[0] + 1j * u[1])
-                           / np.sqrt(2.0).astype(self.rdtype))(
-                    jax.random.normal(k, (2,) + shape, self.rdtype)),
-                out_shardings=sharding)(key)
-        return self.fft.dft(noise)
+        if self._noise_fn is None:
+            shape = self.fft.grid_shape
+            sharding = self.decomp.sharding(0)
+            if self.fft.is_real:
+                self._noise_fn = jax.jit(
+                    lambda k: jax.random.normal(k, shape, self.rdtype),
+                    out_shardings=sharding)
+            else:
+                self._noise_fn = jax.jit(
+                    lambda k: (lambda u: (u[0] + 1j * u[1])
+                               / np.sqrt(2.0).astype(self.rdtype))(
+                        jax.random.normal(k, (2,) + shape, self.rdtype)),
+                    out_shardings=sharding)
+        return self.fft.dft(self._noise_fn(key))
 
-    def _scale(self, nk, f_power_fn, random):
+    def _scale(self, nk, f_power_fn, random, root=None):
         """Scale noise modes to the target spectrum: Rayleigh amplitudes
-        for ``random=True``, exactly ``sqrt(P)`` (phase only) otherwise."""
-        def impl(nk):
-            f_power = f_power_fn()
-            root = jnp.sqrt(f_power).astype(self.rdtype)
+        for ``random=True``, exactly ``sqrt(P)`` (phase only) otherwise.
+        The user's spectrum/window closures are evaluated eagerly over the
+        full k-grid once per call (unfused dispatches; callers doing
+        several scalings pass a precomputed ``root`` instead); the
+        per-mode scaling itself runs through a cached jitted executable."""
+        if root is None:
+            root = jnp.sqrt(jnp.asarray(f_power_fn(), self.rdtype))
+        fn = self._scale_fns.get(bool(random))
+        if fn is None:
+            gs, cdtype = self.grid_size, self.cdtype
             if random:
-                return (nk * (root / np.sqrt(self.grid_size))
-                        ).astype(self.cdtype)
-            mag = jnp.abs(nk)
-            phase = jnp.where(mag > 0, nk / jnp.where(mag > 0, mag, 1),
-                              jnp.asarray(1, self.cdtype))
-            return (phase * root).astype(self.cdtype)
-        return jax.jit(impl, out_shardings=self.fft.k_sharding(0))(nk)
+                def impl(nk, root):
+                    return (nk * (root / np.sqrt(gs))).astype(cdtype)
+            else:
+                def impl(nk, root):
+                    mag = jnp.abs(nk)
+                    phase = jnp.where(mag > 0,
+                                      nk / jnp.where(mag > 0, mag, 1),
+                                      jnp.asarray(1, cdtype))
+                    return (phase * root).astype(cdtype)
+            fn = jax.jit(impl, out_shardings=self.fft.k_sharding(0))
+            self._scale_fns[bool(random)] = fn
+        return fn(nk, root)
 
     def generate(self, queue=None, random=True,
                  field_ps=lambda kmag: 1 / 2 / kmag,
@@ -202,32 +220,36 @@ class RayleighGenerator:
         """
         amplitude_sq = norm / self.volume * self.grid_size**2
 
-        def f_power_fn():
-            kmag = self._kmag_device()
-            zero, kmag_safe = self._protect_zero_mode(kmag)
-            # pointwise omega, so evaluating at the protected kmag equals
-            # the reference's protect-evaluate-restore on wk; the zero mode
-            # has zero power either way, making the wk value there inert
-            wk = jnp.asarray(omega_k(kmag_safe), self.rdtype)
-            return (amplitude_sq * window(kmag)**2
-                    * jnp.where(zero, jnp.asarray(0, self.rdtype),
-                                jnp.asarray(field_ps(wk), self.rdtype)))
+        # evaluate kmag / dispersion / spectrum ONCE; both scalings and the
+        # combine reuse the same full-grid arrays
+        kmag = self._kmag_device()
+        zero, kmag_safe = self._protect_zero_mode(kmag)
+        # pointwise omega, so evaluating at the protected kmag equals
+        # the reference's protect-evaluate-restore on wk; the zero mode
+        # has zero power either way, making the wk value there inert
+        wk = jnp.asarray(omega_k(kmag_safe), self.rdtype)
+        f_power = (amplitude_sq * window(kmag)**2
+                   * jnp.where(zero, jnp.asarray(0, self.rdtype),
+                               jnp.asarray(field_ps(wk), self.rdtype)))
+        root = jnp.sqrt(jnp.asarray(f_power, self.rdtype))
 
         fk = self._scale(self._noise_modes(self._next_key()),
-                         f_power_fn, random)
+                         None, random, root=root)
         dfree = self._scale(self._noise_modes(self._next_key()),
-                            f_power_fn, random)
+                            None, random, root=root)
 
-        def combine(fk, dfree):
-            kmag = self._kmag_device()
-            _, kmag_safe = self._protect_zero_mode(kmag)
-            wk = jnp.asarray(omega_k(kmag_safe), self.rdtype)
-            dfk = (wk * dfree - hubble * fk).astype(self.cdtype)
-            return fk, dfk
+        if self._wkb_combine is None:
+            cdtype = self.cdtype
+            sharding = self.fft.k_sharding(0)
 
-        sharding = self.fft.k_sharding(0)
-        return jax.jit(combine, out_shardings=(sharding, sharding))(
-            fk, dfree)
+            def combine(fk, dfree, wk, hub):
+                dfk = (wk * dfree - hub * fk).astype(cdtype)
+                return fk, dfk
+
+            self._wkb_combine = jax.jit(
+                combine, out_shardings=(sharding, sharding))
+        return self._wkb_combine(fk, dfree, wk,
+                                 jnp.asarray(hubble, self.rdtype))
 
     def init_WKB_fields(self, fx=None, dfx=None, queue=None, **kwargs):
         """Initialize a field and its time derivative via WKB modes; returns

@@ -83,6 +83,7 @@ class DomainDecomposition:
         if np.isscalar(halo_shape):
             halo_shape = (halo_shape,) * 3
         self.halo_shape = tuple(int(h) for h in halo_shape)
+        self._share_halos_cache = {}
 
     # -- shardings ---------------------------------------------------------
 
@@ -240,16 +241,22 @@ class DomainDecomposition:
         """Standalone halo exchange on a global array: returns the *padded*
         global array (shape grown by ``2*halo`` per axis). Mostly useful for
         tests — production stencil ops fuse ``pad_with_halos`` into their own
-        ``shard_map`` bodies."""
+        ``shard_map`` bodies. The jitted executable is cached per
+        ``(halo, outer_axes)``, so repeated calls don't re-trace."""
         if np.isscalar(halo):
             halo = (halo,) * len(self.axis_names)
-        spec = self.spec(outer_axes)
+        halo = tuple(int(h) for h in halo)
+        fn = self._share_halos_cache.get((halo, outer_axes))
+        if fn is None:
+            spec = self.spec(outer_axes)
 
-        def body(x):
-            return self.pad_with_halos(x, halo)
+            def body(x):
+                return self.pad_with_halos(x, halo)
 
-        return jax.jit(jax.shard_map(
-            body, mesh=self.mesh, in_specs=spec, out_specs=spec))(array)
+            fn = jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=spec, out_specs=spec))
+            self._share_halos_cache[(halo, outer_axes)] = fn
+        return fn(array)
 
     def shard_map(self, fn, in_specs, out_specs, **kwargs):
         """Thin wrapper over ``jax.shard_map`` bound to this mesh.

@@ -74,6 +74,16 @@ def script_decomp(proc_shape):
     return ps.DomainDecomposition(proc_shape, devices=jax.devices()[:n])
 
 
+def script_fft(args, box=5.0):
+    """Shared benchmark setup: ``(decomp, lattice, fft)`` for the parsed
+    CLI args (used by the fourier-stack test files' ``__main__`` blocks)."""
+    import pystella_tpu as ps
+    decomp = script_decomp(args.proc_shape)
+    lattice = ps.Lattice(args.grid_shape, (box,) * 3, dtype=args.dtype)
+    fft = ps.DFT(decomp, grid_shape=args.grid_shape, dtype=args.dtype)
+    return decomp, lattice, fft
+
+
 def report(name, ms, nbytes=None, nsites=None):
     """Print one benchmark line: ms/call, optional GB/s and sites/s."""
     extra = ""

@@ -106,3 +106,19 @@ def test_gather_scatter_dtype_combinations(decomp, grid_shape, dtype,
     # reference-API alias
     arr3 = decomp.scatter_array(data)
     np.testing.assert_array_equal(decomp.gather_array(arr3), data)
+
+
+if __name__ == "__main__":
+    # halo-exchange microbenchmark (reference test/common.py:41-56):
+    #   python tests/test_decomp.py -grid 256 256 256 -proc 2 2 2
+    import common
+
+    args = common.parse_args()
+    decomp = common.script_decomp(args.proc_shape)
+    rng = np.random.default_rng(19)
+    arr = decomp.shard(rng.standard_normal(args.grid_shape).astype(args.dtype))
+    nsites = float(np.prod(args.grid_shape))
+    for h in (1, 2, 4):
+        common.report(f"share_halos h={h}",
+                      ps.timer(lambda h=h: decomp.share_halos(arr, h),
+                               ntime=args.ntime), nsites=nsites)

@@ -172,3 +172,32 @@ def test_standalone_relaxation(make_decomp, grid_shape, proc_shape):
     out = solver(decomp, iterations=200, dx=dx, f=f, rho=rho)
     e1 = solver.get_error(level, out, {"rho": rho}, {})["f"][1]
     assert e1 < e0 / 3, (e0, e1)
+
+
+if __name__ == "__main__":
+    # V-cycle microbenchmark (reference test/common.py:41-56):
+    #   python tests/test_multigrid.py -grid 128 128 128
+    import common
+
+    args = common.parse_args()
+    decomp = common.script_decomp(args.proc_shape)
+    dx = 10.0 / args.grid_shape[0]
+
+    f_sym = ps.Field("f")
+    problems = {f_sym: (ps.Field("lap_f") - f_sym + f_sym**3,
+                        ps.Field("rho"))}
+    solver = NewtonIterator(decomp, problems, halo_shape=args.h,
+                            omega=2 / 3, dtype=args.dtype)
+    mg = FullApproximationScheme(solver=solver, halo_shape=args.h)
+
+    rng = np.random.default_rng(23)
+    rho_np = rng.standard_normal(args.grid_shape).astype(args.dtype)
+    rho = decomp.shard(rho_np - rho_np.mean())
+    f0 = decomp.zeros(args.grid_shape, args.dtype)
+
+    def cycle():
+        _, sol = mg(decomp, dx0=dx, f=f0, rho=rho)
+        return sol["f"]
+
+    common.report("FAS V-cycle", ps.timer(cycle, ntime=max(2, args.ntime // 10)),
+                  nsites=float(np.prod(args.grid_shape)))

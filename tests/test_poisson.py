@@ -54,3 +54,23 @@ def test_spectral_solve_plane_wave(setup, grid_shape, proc_shape):
 
     expected = -rho / (kx**2 + ky**2)
     assert np.abs(f - expected).max() < 1e-12
+
+
+if __name__ == "__main__":
+    # spectral Poisson-solve microbenchmark (reference test/common.py:41-56):
+    #   python tests/test_poisson.py -grid 256 256 256
+    import common
+
+    args = common.parse_args()
+    decomp, lattice, fft = common.script_fft(args)
+    solver = ps.SpectralPoissonSolver(
+        fft, lattice.dk, lattice.dx,
+        ps.SecondCenteredDifference(args.h).get_eigenvalues)
+
+    rng = np.random.default_rng(13)
+    rho_np = rng.standard_normal(args.grid_shape).astype(args.dtype)
+    rho = decomp.shard(rho_np - rho_np.mean())
+    nsites = float(np.prod(args.grid_shape))
+    common.report("poisson solve",
+                  ps.timer(lambda: solver(rho=rho), ntime=args.ntime),
+                  nsites=nsites)

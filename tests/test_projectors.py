@@ -149,3 +149,29 @@ def test_tensor_pol_roundtrip(setup, h, proc_shape):
     hij_tt = np.asarray(proj.transverse_traceless(hij))
     diff = np.abs(hij_tt - np.asarray(hij))[:, mask]
     assert diff.max() < 1e-11
+
+
+if __name__ == "__main__":
+    # projection microbenchmark (reference test/common.py:41-56):
+    #   python tests/test_projectors.py -grid 256 256 256
+    import common
+
+    args = common.parse_args()
+    decomp, lattice, fft = common.script_fft(args)
+    proj = ps.Projector(fft, args.h, lattice.dk, lattice.dx)
+
+    kshape = fft.shape(True)
+    rng = np.random.default_rng(9)
+    vec = fft.shard_k((rng.standard_normal((3,) + kshape)
+                       + 1j * rng.standard_normal((3,) + kshape))
+                      .astype(fft.cdtype))
+    hij = fft.shard_k((rng.standard_normal((6,) + kshape)
+                       + 1j * rng.standard_normal((6,) + kshape))
+                      .astype(fft.cdtype))
+    nsites = float(np.prod(kshape))
+    common.report("transversify",
+                  ps.timer(lambda: proj.transversify(vec),
+                           ntime=args.ntime), nsites=nsites)
+    common.report("transverse_traceless",
+                  ps.timer(lambda: proj.transverse_traceless(hij),
+                           ntime=args.ntime), nsites=nsites)

@@ -107,3 +107,21 @@ def test_transverse_vector_init(setup, proc_shape):
     kx, ky, kz = np.meshgrid(*eff, indexing="ij", sparse=True)
     div = kx * vec_k[0] + ky * vec_k[1] + kz * vec_k[2]
     assert np.abs(div).max() / np.abs(vec_k).max() < 1e-10
+
+
+if __name__ == "__main__":
+    # random-field-init microbenchmark (reference test/common.py:41-56):
+    #   python tests/test_rayleigh.py -grid 256 256 256
+    import common
+
+    args = common.parse_args()
+    decomp, lattice, fft = common.script_fft(args)
+    rng_dev = ps.RayleighGenerator(fft=fft, dk=lattice.dk,
+                                   volume=lattice.volume, seed=11)
+    nsites = float(np.prod(args.grid_shape))
+    common.report("init_field",
+                  ps.timer(lambda: rng_dev.init_field(), ntime=args.ntime),
+                  nsites=nsites)
+    common.report("init_WKB_fields",
+                  ps.timer(lambda: rng_dev.init_WKB_fields(),
+                           ntime=args.ntime), nsites=nsites)

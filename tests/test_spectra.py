@@ -98,3 +98,21 @@ def test_gw_spectrum_shapes(setup, grid_shape, proc_shape):
     assert gw_pol.shape == (2, spectra.num_bins)
     # polarization spectra sum to the total (both are TT power)
     assert np.allclose(gw_pol.sum(0)[1:], gw[1:], rtol=1e-8)
+
+
+if __name__ == "__main__":
+    # binned-spectra microbenchmark (reference test/common.py:41-56):
+    #   python tests/test_spectra.py -grid 256 256 256
+    import common
+
+    args = common.parse_args()
+    decomp, lattice, fft = common.script_fft(args)
+    spectra = ps.PowerSpectra(decomp, fft, lattice.dk, lattice.volume)
+
+    rng = np.random.default_rng(7)
+    fx = decomp.shard(
+        rng.standard_normal((2,) + args.grid_shape).astype(args.dtype))
+    nsites = float(np.prod(args.grid_shape))
+    common.report("spectra (2 fields)",
+                  ps.timer(lambda: spectra(fx), ntime=args.ntime),
+                  nsites=nsites)

@@ -60,3 +60,22 @@ def test_divergence_and_pd(setup, grid_shape, proc_shape):
     arr = decomp.shard(f)
     assert np.abs(np.asarray(sc.pdx(arr)) - kx * np.cos(phase)).max() < 1e-10
     assert np.abs(np.asarray(sc.pdz(arr)) - kz * np.cos(phase)).max() < 1e-10
+
+
+if __name__ == "__main__":
+    # spectral-derivative microbenchmark (reference test/common.py:41-56):
+    #   python tests/test_spectral_collocator.py -grid 256 256 256
+    import common
+
+    args = common.parse_args()
+    decomp, lattice, fft = common.script_fft(args)
+    sc = ps.SpectralCollocator(fft, lattice.dk)
+
+    rng = np.random.default_rng(17)
+    arr = decomp.shard(rng.standard_normal(args.grid_shape).astype(args.dtype))
+    nsites = float(np.prod(args.grid_shape))
+    for name, thunk in [("lap", lambda: sc.lap(arr)),
+                        ("grad", lambda: sc.grad(arr)),
+                        ("grad_lap", lambda: sc.grad_lap(arr))]:
+        common.report(name, ps.timer(thunk, ntime=args.ntime),
+                      nsites=nsites)
