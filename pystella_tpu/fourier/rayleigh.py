@@ -121,15 +121,12 @@ class RayleighGenerator:
                     out_shardings=sharding)
         return self.fft.dft(self._noise_fn(key))
 
-    def _scale(self, nk, f_power_fn, random, root=None):
-        """Scale noise modes to the target spectrum: Rayleigh amplitudes
-        for ``random=True``, exactly ``sqrt(P)`` (phase only) otherwise.
-        The user's spectrum/window closures are evaluated eagerly over the
-        full k-grid once per call (unfused dispatches; callers doing
-        several scalings pass a precomputed ``root`` instead); the
-        per-mode scaling itself runs through a cached jitted executable."""
-        if root is None:
-            root = jnp.sqrt(jnp.asarray(f_power_fn(), self.rdtype))
+    def _scale(self, nk, root, random):
+        """Scale noise modes by ``root = sqrt(P)``: Rayleigh amplitudes for
+        ``random=True``, exact amplitudes (phase only) otherwise. Callers
+        evaluate the user's spectrum/window closures eagerly over the full
+        k-grid (unfused dispatches, once per call); the per-mode scaling
+        itself runs through a cached jitted executable."""
         fn = self._scale_fns.get(bool(random))
         if fn is None:
             gs, cdtype = self.grid_size, self.cdtype
@@ -158,16 +155,16 @@ class RayleighGenerator:
         """
         amplitude_sq = norm / self.volume * self.grid_size**2
 
-        def f_power_fn():
-            kmag = self._kmag_device()
-            zero, kmag_safe = self._protect_zero_mode(kmag)
-            return (amplitude_sq * window(kmag)**2
-                    * jnp.where(zero, jnp.asarray(0, self.rdtype),
-                                jnp.asarray(field_ps(kmag_safe),
-                                            self.rdtype)))
+        kmag = self._kmag_device()
+        zero, kmag_safe = self._protect_zero_mode(kmag)
+        f_power = (amplitude_sq * window(kmag)**2
+                   * jnp.where(zero, jnp.asarray(0, self.rdtype),
+                               jnp.asarray(field_ps(kmag_safe),
+                                           self.rdtype)))
+        root = jnp.sqrt(jnp.asarray(f_power, self.rdtype))
 
         nk = self._noise_modes(self._next_key())
-        return self._scale(nk, f_power_fn, random)
+        return self._scale(nk, root, random)
 
     def init_field(self, fx=None, queue=None, **kwargs):
         """Initialize a position-space field with :meth:`generate`'s modes;
@@ -234,9 +231,9 @@ class RayleighGenerator:
         root = jnp.sqrt(jnp.asarray(f_power, self.rdtype))
 
         fk = self._scale(self._noise_modes(self._next_key()),
-                         None, random, root=root)
+                         root, random)
         dfree = self._scale(self._noise_modes(self._next_key()),
-                            None, random, root=root)
+                            root, random)
 
         if self._wkb_combine is None:
             cdtype = self.cdtype
