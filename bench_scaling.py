@@ -1,21 +1,19 @@
 """Weak-scaling benchmark: constant per-device load over growing meshes.
 
 Targets BASELINE.json's second metric — >=85% weak-scaling efficiency from
-8 to 64 chips — by timing the fused preheating step with a fixed per-device
-block while the x-sharded mesh grows: ideal weak scaling keeps ms/step
-constant, so ``efficiency(N) = t(1) / t(N)``. The stencil's communication
-is two (h, Y, Z) halo slabs per stage per neighbor over ICI, independent of
-mesh size, so the model predicts near-flat scaling; this harness measures
-it.
+8 to 64 chips — by timing the headline preheating step (the same model
+``bench.py`` builds) with a fixed per-device block while the x-sharded
+mesh grows: ideal weak scaling keeps ms/step constant, so
+``efficiency(N) = t(1) / t(N)``. The stencil's communication is two
+(h, Y, Z) halo slabs per stage per neighbor over ICI, independent of mesh
+size, so the model predicts near-flat scaling; this harness measures it.
 
 On a TPU slice it reports the real number. On the virtual CPU mesh
 (default: 8 devices via ``--xla_force_host_platform_device_count``) the
-collectives are shared-memory copies — useful as a harness check and a
+"devices" share the same physical cores — useful as a harness check and a
 regression signal for accidental replication, not as a hardware claim.
 
-Prints one JSON line per mesh size:
-``{"metric": "weak-scaling (N devices)", "value": ms_per_step, ...}`` and a
-final efficiency line.
+Prints one JSON line per mesh size and a final efficiency line.
 
 Usage: ``python bench_scaling.py [--local 64] [--devices 1,2,4,8]``
 (set ``PYSTELLA_BENCH_PLATFORM=tpu`` to dial hardware).
@@ -28,65 +26,38 @@ import time
 
 _cpu = os.environ.get("PYSTELLA_BENCH_PLATFORM", "cpu") == "cpu"
 if _cpu:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = \
             _flags + " --xla_force_host_platform_device_count=8"
+    from __graft_entry__ import _drop_remote_tpu_plugin
+    _drop_remote_tpu_plugin()
 
 import numpy as np  # noqa: E402
 import jax  # noqa: E402
 
-if _cpu:
-    from jax._src import xla_bridge as _xb
-    _xb._backend_factories.pop("axon", None)
-    jax.config.update("jax_platforms", "cpu")
+from bench import build_preheat_step  # noqa: E402  (the headline model)
 
 
 def run_mesh(ndev, local_n, nsteps=10, nwarmup=2, dtype=np.float32):
     import pystella_tpu as ps
 
     grid_shape = (local_n * ndev, local_n, local_n)
-    lattice = ps.Lattice(grid_shape, (5.0 * ndev, 5.0, 5.0), dtype=dtype)
-    dt = dtype(0.1 * min(lattice.dx))
     decomp = ps.DomainDecomposition((ndev, 1, 1),
                                     devices=jax.devices()[:ndev])
-
-    mphi, gsq = 1.20e-6, 2.5e-7
-
-    def potential(f):
-        phi, chi = f[0], f[1]
-        return (mphi**2 / 2 * phi**2 + gsq / 2 * phi**2 * chi**2) / mphi**2
-
-    sector = ps.ScalarSector(2, potential=potential)
-    use_fused = jax.default_backend() == "tpu"
-    if use_fused:
-        stepper = ps.FusedScalarStepper(sector, decomp, grid_shape,
-                                        lattice.dx, 2, dtype=dtype, dt=dt)
-    else:
-        # CPU harness check: pallas interpret mode would swamp the
-        # communication signal, so use the XLA halo path
-        fd = ps.FiniteDifferencer(decomp, 2, lattice.dx, mode="halo")
-        rhs = ps.compile_rhs_dict(sector.rhs_dict)
-
-        def full_rhs(s, t, a, hubble):
-            return rhs(s, t, lap_f=fd.lap(s["f"]), a=a, hubble=hubble)
-
-        stepper = ps.LowStorageRK54(full_rhs, dt=dt)
-
-    rng = np.random.default_rng(7)
-    state = {k: decomp.shard(
-        0.1 * rng.standard_normal((2,) + grid_shape).astype(dtype))
-        for k in ("f", "dfdt")}
-    args = {"a": dtype(1.0), "hubble": dtype(0.5)}
+    # fused Pallas stages on TPU; on CPU they would run in interpret mode
+    # and swamp the communication signal, so use the XLA halo path there
+    fused = jax.default_backend() == "tpu"
+    step, state, dt = build_preheat_step(grid_shape, dtype, fused=fused,
+                                         decomp=decomp)
+    t, a, hubble = dtype(0.0), dtype(1.0), dtype(0.5)
 
     for _ in range(nwarmup):
-        state = stepper.step(state, 0.0, dt, args)
+        state = step(state, t, dt, a, hubble)
     jax.block_until_ready(state)
     start = time.perf_counter()
     for _ in range(nsteps):
-        state = stepper.step(state, 0.0, dt, args)
+        state = step(state, t, dt, a, hubble)
     jax.block_until_ready(state)
     return (time.perf_counter() - start) / nsteps * 1e3
 
@@ -103,6 +74,14 @@ def main():
     navail = len(jax.devices())
     if dev_counts is None:
         dev_counts = [d for d in (1, 2, 4, 8, 16, 32, 64) if d <= navail]
+    else:
+        dropped = [d for d in dev_counts if d > navail]
+        if dropped:
+            print(f"# dropping {dropped}: only {navail} devices available",
+                  file=sys.stderr, flush=True)
+        dev_counts = [d for d in dev_counts if d <= navail]
+    if not dev_counts:
+        raise SystemExit("no runnable device counts")
     platform = jax.devices()[0].platform
     suffix = "" if platform == "tpu" else f", {platform}"
 
