@@ -15,8 +15,8 @@ generator and compiler, lattices are ``jax.Array``s sharded over a
 """
 
 from pystella_tpu.field import (
-    Field, DynamicField, Expr, Var,
-    diff, simplify, substitute, evaluate, field_names,
+    Field, DynamicField, Expr, Var, Shifted,
+    diff, simplify, substitute, evaluate, field_names, shift_fields,
     exp, log, sin, cos, tan, sinh, cosh, tanh, sqrt, fabs, sign,
     t, x, y, z,
 )
@@ -25,6 +25,7 @@ from pystella_tpu.parallel import DomainDecomposition, make_mesh
 from pystella_tpu.ops import (
     ElementWiseMap,
     FirstCenteredDifference, SecondCenteredDifference, FiniteDifferencer,
+    expand_stencil, centered_diff,
     Reduction, FieldStatistics,
     Histogrammer, FieldHistogrammer,
 )
@@ -78,8 +79,9 @@ class DisableLogging:
 
 
 __all__ = [
-    "Field", "DynamicField", "Expr", "Var", "diff", "simplify", "substitute",
-    "evaluate", "field_names",
+    "Field", "DynamicField", "Expr", "Var", "Shifted", "diff", "simplify",
+    "substitute", "evaluate", "field_names", "shift_fields",
+    "expand_stencil", "centered_diff",
     "exp", "log", "sin", "cos", "tan", "sinh", "cosh", "tanh", "sqrt",
     "fabs", "sign", "t", "x", "y", "z",
     "Lattice", "DomainDecomposition", "make_mesh",

@@ -246,6 +246,66 @@ class Indexed(Expr):
         return f"{self.field.name}[{', '.join(map(str, self.index))}]"
 
 
+class Shifted(Expr):
+    """A field (or indexed component) evaluated at a lattice-site offset:
+    ``Shifted(f, (1, 0, 0))`` is the reference's ``f[i+1, j, k]``
+    (``shift_fields``, /root/reference/pystella/field/__init__.py:471-491).
+    Under :func:`evaluate` this is a periodic ``jnp.roll`` over the three
+    trailing lattice axes — the array-level meaning of a subscript shift on
+    a periodic lattice. Like the reference construct (which lives inside
+    kernels whose halos were pre-exchanged), this evaluates on *unsharded*
+    (or replicated) lattice axes; on sharded meshes use the
+    halo-exchanging operators (``FiniteDifferencer``), whose ``ppermute``
+    pads play the role shifts play symbolically."""
+
+    _fields = ("child", "shift")
+
+    def __init__(self, child, shift):
+        self.child = child
+        self.shift = tuple(int(s) for s in shift)
+        if len(self.shift) != 3:
+            raise ValueError("shift must be a 3-tuple of site offsets")
+
+    def _key(self):
+        return ("Shifted", self.child._key(), self.shift)
+
+    def __repr__(self):
+        return f"Shifted({self.child!r}, {self.shift})"
+
+
+def shift_fields(expr, shift):
+    """Return ``expr`` with every :class:`Field`/:class:`Indexed` leaf read
+    at lattice offset ``shift`` (a 3-tuple of site counts). Reference-API
+    analog of ``shift_fields`` (field/__init__.py:471-491), with array
+    semantics instead of subscript rewriting: shifted leaves evaluate to
+    periodic rolls. Scalars (:class:`Var`, constants) are unaffected."""
+    shift = tuple(int(s) for s in shift)
+    expr = _wrap(expr)
+    if not any(shift):
+        return expr
+
+    def walk(e):
+        e = _wrap(e)
+        if isinstance(e, (Field, Indexed)):
+            return Shifted(e, shift)
+        if isinstance(e, Shifted):
+            total = tuple(a + b for a, b in zip(e.shift, shift))
+            return Shifted(e.child, total) if any(total) else e.child
+        if isinstance(e, Sum):
+            return Sum.make(*(walk(c) for c in e.children))
+        if isinstance(e, Product):
+            return Product.make(*(walk(c) for c in e.children))
+        if isinstance(e, Quotient):
+            return Quotient(walk(e.num), walk(e.den))
+        if isinstance(e, Power):
+            return Power(walk(e.base), walk(e.exponent))
+        if isinstance(e, Call):
+            return Call(e.func, tuple(walk(a) for a in e.args))
+        return e
+
+    return walk(expr)
+
+
 class DynamicField(Field):
     """A field with bundled time-derivative / Laplacian / gradient fields.
 
@@ -329,6 +389,16 @@ def evaluate(expr, env):
         return env[expr.name]
     if isinstance(expr, Var):
         return env[expr.name]
+    if isinstance(expr, Shifted):
+        val = evaluate(expr.child, env)
+        # subscript shift f[i+s] reads site i+s, i.e. roll by -s; periodic
+        # wrap matches the lattice boundary conditions. A homogeneous value
+        # (fewer than 3 lattice axes, e.g. a scalar background) is shift-
+        # invariant, preserving the "lattice axes broadcast" contract.
+        if getattr(val, "ndim", 0) < 3:
+            return val
+        return jnp.roll(val, tuple(-s for s in expr.shift),
+                        axis=(-3, -2, -1))
     if isinstance(expr, Sum):
         return reduce(lambda a, b: a + b,
                       (evaluate(c, env) for c in expr.children))
@@ -372,6 +442,8 @@ def field_names(expr):
             out.add(e.name)
         elif isinstance(e, Var):
             out.add(e.name)
+        elif isinstance(e, Shifted):
+            visit(e.child)
         elif isinstance(e, Sum) or isinstance(e, Product):
             for c in e.children:
                 visit(c)
@@ -409,6 +481,8 @@ def substitute(expr, mapping):
                      substitute(expr.exponent, mapping))
     if isinstance(expr, Call):
         return Call(expr.func, tuple(substitute(a, mapping) for a in expr.args))
+    if isinstance(expr, Shifted):
+        return Shifted(substitute(expr.child, mapping), expr.shift)
     return expr
 
 
@@ -453,6 +527,12 @@ def _diff1(expr, var):
                 return Constant(1)
             if isinstance(e, (Constant, Field, Var, Indexed)):
                 return Constant(0)
+            if isinstance(e, Shifted):
+                # coordinate derivatives commute with lattice shifts
+                inner = coord_diff(e.child)
+                if isinstance(inner, Constant) and inner.value == 0:
+                    return inner
+                return Shifted(inner, e.shift)
             return _structural_diff(e, coord_diff)
         return coord_diff(expr)
 
@@ -463,6 +543,12 @@ def _diff1(expr, var):
         if isinstance(e, (Constant, Var)):
             return Constant(0)
         if isinstance(e, (Field, Indexed)):
+            return Constant(0)
+        if isinstance(e, Shifted):
+            # a shifted field occurrence lives at a different lattice site,
+            # independent of the origin-site variable (unless var is the
+            # same shifted expression, caught by the e == var test; to
+            # differentiate through a shift, substitute first)
             return Constant(0)
         return _structural_diff(e, ddvar)
     return ddvar(expr)

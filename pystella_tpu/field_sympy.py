@@ -21,7 +21,7 @@ import numbers
 
 from pystella_tpu.field import (
     Call, Constant, DynamicField, Expr, Field, Indexed, Power, Product,
-    Quotient, Sum, Var, _wrap,
+    Quotient, Shifted, Sum, Var, _wrap,
 )
 
 __all__ = ["to_sympy", "from_sympy", "simplify", "SympyField",
@@ -54,19 +54,21 @@ def reset_field_registry():
     _FIELD_REGISTRY.clear()
 
 
-def SympyField(field, index=()):
+def SympyField(field, index=(), shift=()):
     """A sympy leaf that remembers the originating :class:`Field`.
 
     The reference subclasses ``sym.Indexed`` (sympy.py:40-56); here a plain
     ``sympy.Symbol`` with a registry entry suffices — sympy's simplification
-    treats it atomically, and :func:`from_sympy` restores the Field (and its
-    index) from the registry.
+    treats it atomically, and :func:`from_sympy` restores the Field (and
+    its index / lattice shift) from the registry.
     """
     sym = _sympy()
+    name = field.name
     if index:
-        name = f"{field.name}__idx__" + "_".join(map(str, index))
-    else:
-        name = field.name
+        name += "__idx__" + "_".join(map(str, index))
+    if shift and any(shift):
+        name += "__sft__" + "_".join(
+            f"m{-s}" if s < 0 else str(s) for s in shift)
     s = sym.Symbol(name)
     prior = _FIELD_REGISTRY.get(name)
     if prior is not None and prior[0]._key() != field._key():
@@ -74,7 +76,7 @@ def SympyField(field, index=()):
             f"sympy round-trip name collision: two distinct Fields both "
             f"map to symbol {name!r} ({prior[0]!r} vs {field!r}); rename "
             f"one of them")
-    _FIELD_REGISTRY[name] = (field, tuple(index))
+    _FIELD_REGISTRY[name] = (field, tuple(index), tuple(shift))
     return s
 
 
@@ -106,6 +108,14 @@ def to_sympy(expr):
         return SympyField(expr.field, expr.index)
     if isinstance(expr, Field):
         return SympyField(expr)
+    if isinstance(expr, Shifted):
+        child = expr.child
+        if isinstance(child, Indexed):
+            return SympyField(child.field, child.index, expr.shift)
+        if isinstance(child, Field):
+            return SympyField(child, (), expr.shift)
+        raise TypeError(
+            "only shifted Field/Indexed leaves convert to sympy")
     if isinstance(expr, Var):
         return sym.Symbol(expr.name)
     if isinstance(expr, Sum):
@@ -134,8 +144,11 @@ def from_sympy(s_expr):
     if isinstance(s_expr, sym.Symbol):
         entry = _FIELD_REGISTRY.get(s_expr.name)
         if entry is not None:
-            field, index = entry
-            return field[index] if index else field
+            field, index, shift = entry
+            out = field[index] if index else field
+            if shift and any(shift):
+                out = Shifted(out, shift)
+            return out
         return Var(s_expr.name)
     if isinstance(s_expr, (sym.Integer, int)):
         return Constant(int(s_expr))

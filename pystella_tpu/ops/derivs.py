@@ -29,8 +29,36 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "FirstCenteredDifference", "SecondCenteredDifference",
-    "FiniteDifferencer",
+    "FiniteDifferencer", "expand_stencil", "centered_diff",
 ]
+
+
+def expand_stencil(f, coefs):
+    """Expand a symbolic stencil over a field: ``sum_s coefs[s] * f@s``
+    where ``s`` ranges over 3-tuple site offsets (reference
+    ``pystella.derivs.expand_stencil``, derivs.py:37-58). The result
+    evaluates to periodic rolls via :func:`pystella_tpu.field.evaluate` —
+    useful for custom operators without touching the Pallas/halo tiers."""
+    from pystella_tpu.field import shift_fields
+    return sum(c * shift_fields(f, offset) for offset, c in coefs.items())
+
+
+def centered_diff(f, coefs, direction, order):
+    """Centered-difference stencil from its non-redundant coefficients:
+    ``direction`` in (1, 2, 3) picks the axis, ``order``'s parity sets the
+    sign of the mirrored coefficients (reference
+    ``pystella.derivs.centered_diff``, derivs.py:61-108)."""
+    all_coefs = {}
+    for s, c in coefs.items():
+        offset = [0, 0, 0]
+        if s != 0 or order % 2 == 0:
+            offset[direction - 1] = s
+            all_coefs[tuple(offset)] = c
+        if s != 0:
+            offset = [0, 0, 0]
+            offset[direction - 1] = -s
+            all_coefs[tuple(offset)] = (-1) ** order * c
+    return expand_stencil(f, all_coefs)
 
 
 class FiniteDifferenceStencil:

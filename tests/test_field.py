@@ -147,3 +147,82 @@ def test_field_names():
     f = ps.DynamicField("f")
     names = ps.field_names(f.lap - 2 * f.dot + f ** 2)
     assert names == {"lap_f", "dfdt", "f"}
+
+
+def test_shift_fields_evaluates_to_periodic_roll():
+    """Reference shift_fields semantics (field/__init__.py:471-491): a
+    shifted Field reads the neighbor at +offset, i.e. a periodic roll."""
+    import jax.numpy as jnp
+    from pystella_tpu.field import shift_fields, evaluate, Shifted
+
+    f = ps.Field("f")
+    rng = np.random.default_rng(3)
+    arr = jnp.asarray(rng.random((4, 5, 6)))
+
+    shifted = shift_fields(f, (1, 0, -2))
+    out = evaluate(shifted, {"f": arr})
+    np.testing.assert_allclose(np.asarray(out),
+                               np.roll(np.asarray(arr), (-1, 0, 2),
+                                       axis=(0, 1, 2)))
+
+    # scalars are unaffected; shifts compose additively
+    expr = shift_fields(ps.Var("a") * f, (1, 0, 0))
+    out = evaluate(expr, {"f": arr, "a": 2.0})
+    np.testing.assert_allclose(
+        np.asarray(out), 2.0 * np.roll(np.asarray(arr), -1, axis=0))
+
+    double = shift_fields(shift_fields(f, (1, 0, 0)), (-1, 0, 0))
+    assert double == f  # offsets cancel exactly
+    assert isinstance(shift_fields(f, (2, 0, 0)), Shifted)
+
+    # homogeneous (scalar) backgrounds are shift-invariant
+    assert evaluate(shift_fields(f, (1, 0, 0)), {"f": 2.0}) == 2.0
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1)], indirect=True)
+def test_expand_stencil_matches_finite_differencer(decomp, grid_shape,
+                                                   proc_shape):
+    """A symbolic centered stencil built with expand_stencil/centered_diff
+    (reference derivs.py:37-108) evaluates to the same laplacian the
+    FiniteDifferencer computes."""
+    from pystella_tpu.field import evaluate
+    from pystella_tpu.ops.derivs import _lap_coefs
+
+    import jax
+
+    h, dx = 2, 0.37
+    f = ps.Field("f")
+    lap_sym = sum(
+        ps.centered_diff(f, {s: c for s, c in _lap_coefs[h].items()},
+                         direction=d, order=2)
+        for d in (1, 2, 3)) / dx**2
+
+    rng = np.random.default_rng(5)
+    arr = rng.random(grid_shape)
+    # shifted expressions evaluate via jnp.roll: on sharded meshes that
+    # needs jit (like production rhs evaluation inside the steppers)
+    got = np.asarray(jax.jit(
+        lambda a: evaluate(lap_sym, {"f": a}))(decomp.shard(arr)))
+
+    fd = ps.FiniteDifferencer(decomp, h, dx, mode="halo")
+    expected = np.asarray(fd.lap(decomp.shard(arr)))
+    np.testing.assert_allclose(got, expected, atol=1e-12)
+
+
+def test_shifted_diff_semantics():
+    """Shifted occurrences are independent of the origin-site field
+    (reference pymbolic semantics: d f[i+1] / d f[i] = 0), while
+    coordinate derivatives commute with shifts."""
+    from pystella_tpu.field import Shifted, shift_fields, evaluate
+
+    f = ps.Field("f")
+    expr = shift_fields(f**2, (1, 0, 0))
+    d = ps.diff(expr, f)  # d/df of f(x+1)^2 at origin site: zero
+    import jax.numpy as jnp
+    arr = jnp.asarray(np.random.default_rng(0).random((4, 4, 4)))
+    assert np.allclose(np.asarray(evaluate(d, {"f": arr})), 0.0)
+
+    # d/dt commutes with the shift: shift(g).diff(t) == shift(g.dot)
+    g = ps.DynamicField("g")
+    dt_of_shift = ps.diff(shift_fields(g, (0, 1, 0)), ps.t)
+    assert dt_of_shift == Shifted(g.dot, (0, 1, 0))

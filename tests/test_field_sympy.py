@@ -75,3 +75,19 @@ def test_rational_constants():
     expr = field_sympy.simplify(f / 3 + f / 6)
     env = {"f": np.array(2.0)}
     assert np.allclose(float(ps.evaluate(expr, env)), 1.0)
+
+
+def test_shifted_round_trip():
+    """Stencil expressions (Shifted leaves) survive the sympy round trip."""
+    from pystella_tpu.field_sympy import simplify as sym_simplify
+
+    f = ps.Field("f")
+    stencil = ps.expand_stencil(f, {(1, 0, 0): 1, (-1, 0, 0): -1})
+    out = sym_simplify(stencil)
+
+    import jax.numpy as jnp
+    arr = jnp.asarray(np.random.default_rng(1).random((4, 4, 4)))
+    from pystella_tpu.field import evaluate
+    np.testing.assert_allclose(
+        np.asarray(evaluate(out, {"f": arr})),
+        np.asarray(evaluate(stencil, {"f": arr})))
