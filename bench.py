@@ -94,9 +94,15 @@ def sync(tree):
 # ---------------------------------------------------------------------------
 
 def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
-                       fused=True, decomp=None):
+                       fused="auto", decomp=None):
     import jax
     import pystella_tpu as ps
+
+    if fused == "auto":
+        # fused Pallas stages on TPU; on CPU they would run in interpret
+        # mode (~100x slower than the XLA path) and misrepresent the
+        # framework
+        fused = jax.default_backend() == "tpu"
 
     lattice = ps.Lattice(grid_shape, (5.0, 5.0, 5.0), dtype=dtype)
     dt = dtype(0.1 * min(lattice.dx))
@@ -145,8 +151,11 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
     return step, state, dt
 
 
-def run_preheat(n, nsteps=10, nwarmup=2, dtype=np.float32, fused=True):
+def run_preheat(n, nsteps=10, nwarmup=2, dtype=np.float32, fused="auto"):
+    import jax
     grid_shape = (n, n, n)
+    if fused == "auto":
+        fused = jax.default_backend() == "tpu"
     label = "fused" if fused else "generic"
     hb(f"{n}^3 ({label}): building model")
     step, state, dt = build_preheat_step(grid_shape, dtype, fused=fused)

@@ -45,11 +45,7 @@ def run_mesh(ndev, local_n, nsteps=10, nwarmup=2, dtype=np.float32):
     grid_shape = (local_n * ndev, local_n, local_n)
     decomp = ps.DomainDecomposition((ndev, 1, 1),
                                     devices=jax.devices()[:ndev])
-    # fused Pallas stages on TPU; on CPU they would run in interpret mode
-    # and swamp the communication signal, so use the XLA halo path there
-    fused = jax.default_backend() == "tpu"
-    step, state, dt = build_preheat_step(grid_shape, dtype, fused=fused,
-                                         decomp=decomp)
+    step, state, dt = build_preheat_step(grid_shape, dtype, decomp=decomp)
     t, a, hubble = dtype(0.0), dtype(1.0), dtype(0.5)
 
     for _ in range(nwarmup):
