@@ -93,16 +93,22 @@ def sync(tree):
 # headline: fused preheating step
 # ---------------------------------------------------------------------------
 
+def _resolve_fused(fused):
+    """"auto" -> fused Pallas stages on TPU only; on CPU they would run
+    in interpret mode (~100x slower than the XLA path) and misrepresent
+    the framework."""
+    if fused == "auto":
+        import jax
+        return jax.default_backend() == "tpu"
+    return fused
+
+
 def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
                        fused="auto", decomp=None):
     import jax
     import pystella_tpu as ps
 
-    if fused == "auto":
-        # fused Pallas stages on TPU; on CPU they would run in interpret
-        # mode (~100x slower than the XLA path) and misrepresent the
-        # framework
-        fused = jax.default_backend() == "tpu"
+    fused = _resolve_fused(fused)
 
     lattice = ps.Lattice(grid_shape, (5.0, 5.0, 5.0), dtype=dtype)
     dt = dtype(0.1 * min(lattice.dx))
@@ -152,10 +158,8 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
 
 
 def run_preheat(n, nsteps=10, nwarmup=2, dtype=np.float32, fused="auto"):
-    import jax
     grid_shape = (n, n, n)
-    if fused == "auto":
-        fused = jax.default_backend() == "tpu"
+    fused = _resolve_fused(fused)
     label = "fused" if fused else "generic"
     hb(f"{n}^3 ({label}): building model")
     step, state, dt = build_preheat_step(grid_shape, dtype, fused=fused)

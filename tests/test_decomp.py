@@ -10,7 +10,13 @@ import pystella_tpu as ps
 
 @pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1), (2, 2, 2)],
                          indirect=True)
-@pytest.mark.parametrize("h", [1, 2])
+@pytest.mark.parametrize("h", [1, 2,
+                               # anisotropic halos incl. zero-width axes
+                               # and a non-cubic grid, per the reference's
+                               # parameter matrix (test_decomp.py:34-41)
+                               (2, 0, 3), (0, 2, 1)])
+@pytest.mark.parametrize("grid_shape", [(16, 16, 16), (32, 16, 8)],
+                         indirect=True)
 def test_share_halos(decomp, grid_shape, proc_shape, h):
     import jax
     rng = np.random.default_rng(7)
@@ -19,15 +25,18 @@ def test_share_halos(decomp, grid_shape, proc_shape, h):
 
     padded = decomp.share_halos(arr, h)
 
+    if np.isscalar(h):
+        h = (h,) * 3
+
     # every local shard must equal the wrap-padded slab of the global array
     rank_shape = decomp.rank_shape(grid_shape)
-    padded_local = tuple(n + 2 * h for n in rank_shape)
+    padded_local = tuple(n + 2 * hi for n, hi in zip(rank_shape, h))
     for shard in padded.addressable_shards:
         block_pos = tuple((s.start or 0) // p
                           for s, p in zip(shard.index, padded_local))
         expected_idx = tuple(
-            np.arange(b * n - h, (b + 1) * n + h) % g
-            for b, n, g in zip(block_pos, rank_shape, grid_shape))
+            np.arange(b * n - hi, (b + 1) * n + hi) % g
+            for b, n, g, hi in zip(block_pos, rank_shape, grid_shape, h))
         expected = host[np.ix_(*expected_idx)]
         assert np.array_equal(np.asarray(shard.data), expected), \
             f"halo mismatch at block {block_pos}"

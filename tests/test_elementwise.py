@@ -1,0 +1,77 @@
+"""ElementWiseMap tests (analog of the reference's elementwise usage —
+/root/reference/pystella/elementwise.py:81-361 — minus the codegen, which
+XLA owns here), plus the auxiliary utilities the reference exercises in
+passing (DisableLogging, device-chooser shim, StepTimer)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
+def test_elementwise_map(decomp, grid_shape, proc_shape):
+    f, g = ps.Field("f"), ps.Field("g")
+    a = ps.Var("a")
+
+    ewm = ps.ElementWiseMap({ps.Field("out"): a * f + g**2})
+    rng = np.random.default_rng(41)
+    fh = rng.random(grid_shape)
+    gh = rng.random(grid_shape)
+
+    res = ewm(f=decomp.shard(fh), g=decomp.shard(gh), a=3.0)
+    np.testing.assert_allclose(np.asarray(res["out"]), 3.0 * fh + gh**2,
+                               rtol=1e-12)
+
+
+def test_elementwise_map_temporaries(decomp, grid_shape):
+    """tmp_instructions feed later expressions (reference temporaries,
+    elementwise.py:173-193)."""
+    f = ps.Field("f")
+    tmp = ps.Field("tmp")
+
+    ewm = ps.ElementWiseMap({ps.Field("out"): tmp + 1},
+                            tmp_instructions={tmp: 2 * f})
+    fh = np.random.default_rng(42).random(grid_shape)
+    res = ewm(f=decomp.shard(fh))
+    np.testing.assert_allclose(np.asarray(res["out"]), 2 * fh + 1,
+                               rtol=1e-12)
+
+
+def test_disable_logging_context():
+    logger = logging.getLogger("pystella_tpu.test_dummy")
+    records = []
+
+    class Catch(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Catch()
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        logger.info("before")
+        with ps.DisableLogging():
+            logger.info("suppressed")
+        logger.info("after")
+    finally:
+        logger.removeHandler(handler)
+    assert records == ["before", "after"]
+
+
+def test_choose_device_shim():
+    ctx, device = ps.choose_device_and_make_context()
+    assert ctx is None
+    import jax
+    assert device == jax.devices()[0]
+
+
+def test_step_timer_reports():
+    timer = ps.StepTimer(report_every=0.0)  # report on every tick
+    assert timer.tick() is None  # first tick only sets the baseline
+    out = timer.tick()
+    assert out is not None
+    ms_per_step, steps_per_s = out
+    assert ms_per_step >= 0 and steps_per_s >= 0

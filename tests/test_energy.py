@@ -99,3 +99,28 @@ def test_get_rho_and_p_consistency(decomp, grid_shape):
                 - np.sum(energy["gradient"]) / 3
                 - np.sum(energy["potential"]))
     assert np.allclose(energy["pressure"], pressure, rtol=1e-12)
+
+
+@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 2)], indirect=True)
+@pytest.mark.parametrize("max_min", [False, True])
+def test_field_statistics(decomp, grid_shape, proc_shape, max_min):
+    """Mean/variance (+extrema) per outer component vs direct numpy
+    (reference test pattern for reduction.py:258-343)."""
+    rng = np.random.default_rng(29)
+    host = rng.standard_normal((2,) + grid_shape) * [[[[2.0]]], [[[0.5]]]]
+    stats = ps.FieldStatistics(decomp, max_min=max_min)
+    out = stats(f=decomp.shard(host))
+
+    lat = (1, 2, 3)
+    np.testing.assert_allclose(out["mean"], host.mean(axis=lat), rtol=1e-12)
+    np.testing.assert_allclose(out["variance"], host.var(axis=lat),
+                               rtol=1e-10)
+    if max_min:
+        np.testing.assert_array_equal(out["max"], host.max(axis=lat))
+        np.testing.assert_array_equal(out["min"], host.min(axis=lat))
+        np.testing.assert_array_equal(out["abs_max"],
+                                      np.abs(host).max(axis=lat))
+        np.testing.assert_array_equal(out["abs_min"],
+                                      np.abs(host).min(axis=lat))
+    else:
+        assert "max" not in out

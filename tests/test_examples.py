@@ -70,3 +70,16 @@ def test_scalar_preheating_gws(tmp_path):
     import h5py
     with h5py.File(tmp_path / "gw.h5", "r") as f:
         assert "spectra" in f and "gw" in f["spectra"]
+
+
+def test_scalar_preheating_fused_matches_golden(tmp_path):
+    """The --fused (Pallas, interpret-mode on CPU) driver path must land on
+    the same golden constraint as the generic path: same physics, same
+    realization, different execution tier."""
+    stdout = run_example(
+        "scalar_preheating.py", "-grid", "32", "32", "32", "-end-t", "1",
+        "--fused", "--outfile", str(tmp_path / "fused"))
+    line = [ln for ln in stdout.splitlines() if "final constraint" in ln][-1]
+    constraint = float(line.split()[-1])
+    assert abs(constraint - GOLDEN_CONSTRAINT) / GOLDEN_CONSTRAINT < 1e-3, \
+        f"constraint {constraint} vs golden {GOLDEN_CONSTRAINT}"
