@@ -86,35 +86,29 @@ class SpectralCollocator:
         return self.fft._idft_impl(div_k).astype(vec.dtype)
 
     # -- public interface (mirrors FiniteDifferencer) ----------------------
-    # calls enter the mesh context: the pencil reshards trace inside
+    # (reshard targets carry their mesh, so no ambient context is needed
+    # whether called eagerly or inside a caller's jit)
 
     def lap(self, f):
-        with self.fft._with_mesh():
-            return self._lap(f)
+        return self._lap(f)
 
     def grad(self, f):
-        with self.fft._with_mesh():
-            return self._grad(f)
+        return self._grad(f)
 
     def grad_lap(self, f):
-        with self.fft._with_mesh():
-            return self._grad_lap(f)
+        return self._grad_lap(f)
 
     def pdx(self, f):
-        with self.fft._with_mesh():
-            return self._pd(f, 0)
+        return self._pd(f, 0)
 
     def pdy(self, f):
-        with self.fft._with_mesh():
-            return self._pd(f, 1)
+        return self._pd(f, 1)
 
     def pdz(self, f):
-        with self.fft._with_mesh():
-            return self._pd(f, 2)
+        return self._pd(f, 2)
 
     def divergence(self, vec):
-        with self.fft._with_mesh():
-            return self._div(vec)
+        return self._div(vec)
 
     def __call__(self, fx, *, lap=False, grd=False, div=False):
         out = {}

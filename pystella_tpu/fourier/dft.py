@@ -220,15 +220,24 @@ class DFT:
         return [n if decomp.proc_shape[i] > 1 else None
                 for i, n in enumerate(decomp.axis_names)]
 
+    def _replicated(self):
+        """Fully-replicated NamedSharding (replicate-fallback target)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.decomp.mesh, P())
+
     def _specs(self, outer):
-        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding, PartitionSpec as P
         names = self._names()
         mixed = tuple(n for n in names if n is not None)
         o = (None,) * outer
-        return (P(*o, names[0], names[1], names[2]),   # position-space home
-                P(*o, names[0], names[1], None),       # k-space home, z local
-                P(*o, mixed or None, None, None),      # x sharded, y/z local
-                P(*o, None, mixed or None, None))      # y sharded, x/z local
+        # concrete NamedShardings (mesh embedded): ``reshard`` then needs
+        # no ambient mesh context, so transforms trace identically in
+        # eager calls and inside callers' jits
+        ns = (lambda *ent: NamedSharding(self.decomp.mesh, P(*o, *ent)))
+        return (ns(names[0], names[1], names[2]),   # position-space home
+                ns(names[0], names[1], None),       # k-space home, z local
+                ns(mixed or None, None, None),      # x sharded, y/z local
+                ns(None, mixed or None, None))      # y sharded, x/z local
 
     def _mid_spec(self, outer):
         """Staging layout for z-sharded meshes: z local, z's mesh devices
@@ -236,10 +245,12 @@ class DFT:
         one the SPMD partitioner lowers as collectives; the direct
         home -> x-pencil jump triggers its involuntary-full-rematerialization
         fallback (replicate-then-repartition)."""
-        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding, PartitionSpec as P
         names = self._names()
         yz = tuple(n for n in names[1:] if n is not None)
-        return P(*((None,) * outer), names[0], yz or None, None)
+        return NamedSharding(
+            self.decomp.mesh,
+            P(*((None,) * outer), names[0], yz or None, None))
 
     def _dft_impl(self, fx):
         from jax.sharding import reshard
@@ -249,8 +260,7 @@ class DFT:
                 fx, axes=(-3, -2, -1))
         phome, khome, x_shard, y_shard = self._specs(outer)
         if not self._pencil_ok:
-            full = jax.sharding.PartitionSpec(*(None,) * fx.ndim)
-            xk = reshard(fx, full)
+            xk = reshard(fx, self._replicated())
             xk = (jnp.fft.rfftn if self.is_real else jnp.fft.fftn)(
                 xk, axes=(-3, -2, -1))
             return reshard(xk, khome)
@@ -279,8 +289,7 @@ class DFT:
             return jnp.fft.ifftn(fk, axes=(-3, -2, -1))
         phome, khome, x_shard, y_shard = self._specs(outer)
         if not self._pencil_ok:
-            full = jax.sharding.PartitionSpec(*(None,) * fk.ndim)
-            xk = reshard(fk, full)
+            xk = reshard(fk, self._replicated())
             if self.is_real:
                 xk = jnp.fft.irfftn(xk, s=self.grid_shape, axes=(-3, -2, -1))
             else:
@@ -308,17 +317,11 @@ class DFT:
             return jnp.fft.irfft(xk, n=self.grid_shape[-1], axis=-1)
         return jnp.fft.ifft(xk, axis=-1)
 
-    def _with_mesh(self):
-        """Context entering this decomposition's mesh (required by
-        ``reshard`` at trace time)."""
-        return jax.set_mesh(self.decomp.mesh)
-
     def k_sharding(self, outer_axes=0):
         """``NamedSharding`` of k-space arrays: x/y as the decomposition,
         the (half-spectrum) z axis always local."""
-        from jax.sharding import NamedSharding
         _, khome, _, _ = self._specs(outer_axes)
-        return NamedSharding(self.decomp.mesh, khome)
+        return khome
 
     def shard_k(self, array, outer_axes=None):
         """Place a host k-space array on the mesh in the k-home layout."""
@@ -332,16 +335,14 @@ class DFT:
         are immutable here)."""
         arr = fx if not isinstance(fx, np.ndarray) else \
             self.decomp.shard(np.asarray(fx, self.dtype))
-        with self._with_mesh():
-            return self._dft(arr)
+        return self._dft(arr)
 
     def idft(self, fk=None, fx=None, **kwargs):
         """Backward (normalized) transform. Returns the position-space
         array."""
         arr = fk if not isinstance(fk, np.ndarray) else \
             self.shard_k(np.asarray(fk, self.cdtype))
-        with self._with_mesh():
-            out = self._idft(arr)
+        out = self._idft(arr)
         if self.is_real:
             out = out.astype(self.dtype)
         return out

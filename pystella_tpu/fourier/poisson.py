@@ -57,5 +57,4 @@ class SpectralPoissonSolver:
         here the solution is returned)."""
         if rho is None:
             raise ValueError("rho is required")
-        with self.fft._with_mesh():
-            return self._solve(rho, m_squared)
+        return self._solve(rho, m_squared)

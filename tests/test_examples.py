@@ -83,3 +83,14 @@ def test_scalar_preheating_fused_matches_golden(tmp_path):
     constraint = float(line.split()[-1])
     assert abs(constraint - GOLDEN_CONSTRAINT) / GOLDEN_CONSTRAINT < 1e-3, \
         f"constraint {constraint} vs golden {GOLDEN_CONSTRAINT}"
+
+
+def test_scalar_preheating_spectral_derivs(tmp_path):
+    """--halo-shape 0 selects the SpectralCollocator (FFT) derivative path
+    end-to-end (reference scalar_preheating.py:92-96)."""
+    stdout = run_example(
+        "scalar_preheating.py", "-grid", "16", "16", "16", "-end-t", "0.3",
+        "--halo-shape", "0", "--outfile", str(tmp_path / "spec"))
+    assert "Simulation complete" in stdout
+    line = [ln for ln in stdout.splitlines() if "final constraint" in ln][-1]
+    assert float(line.split()[-1]) < 1e-4
