@@ -15,9 +15,16 @@ shared with the test files' ``__main__`` benchmark scripts.
 
 import os
 
-os.environ["PYSTELLA_BENCH_PLATFORM"] = "cpu"  # the suite always runs CPU
+# The suite runs on the virtual CPU mesh by default. Set
+# PYSTELLA_TEST_PLATFORM=tpu to run it on real hardware instead (Pallas
+# kernels then execute Mosaic-compiled rather than in interpret mode —
+# the on-device parity run of tests/test_pallas_stencil.py and
+# tests/test_fused.py).
+os.environ["PYSTELLA_BENCH_PLATFORM"] = os.environ.get(
+    "PYSTELLA_TEST_PLATFORM",
+    os.environ.get("PYSTELLA_BENCH_PLATFORM", "cpu"))
 
-import common  # noqa: F401, E402  (side effect: forces the CPU backend)
+import common  # noqa: F401, E402  (side effect: forces the platform)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -75,3 +75,32 @@ def test_step_timer_reports():
     assert out is not None
     ms_per_step, steps_per_s = out
     assert ms_per_step >= 0 and steps_per_s >= 0
+
+
+def test_trace_writes_profile(tmp_path):
+    """ps.trace wraps jax.profiler and must produce a trace directory."""
+    import jax.numpy as jnp
+
+    with ps.trace(str(tmp_path)):
+        x = jnp.ones((64, 64))
+        (x @ x).block_until_ready()
+    import os
+    found = []
+    for root, _, files in os.walk(tmp_path):
+        found.extend(files)
+    assert found, "no trace files written"
+
+
+def test_make_mesh_shapes():
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = ps.make_mesh((2, 2, 1), devices=jax.devices()[:4])
+    assert mesh.axis_names == ("x", "y", "z")
+    assert mesh.devices.shape == (2, 2, 1)
+    with pytest.raises(ValueError, match="does not cover"):
+        ps.make_mesh((3, 1, 1), devices=jax.devices()[:4])
+
+    # pass an existing mesh straight through the decomposition
+    decomp = ps.DomainDecomposition(mesh=mesh)
+    assert decomp.proc_shape == (2, 2, 1)
