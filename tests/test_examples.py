@@ -94,3 +94,21 @@ def test_scalar_preheating_spectral_derivs(tmp_path):
     assert "Simulation complete" in stdout
     line = [ln for ln in stdout.splitlines() if "final constraint" in ln][-1]
     assert float(line.split()[-1]) < 1e-4
+
+
+def test_scalar_preheating_checkpoint_resume(tmp_path):
+    """Two sequential runs sharing a checkpoint directory: the second must
+    resume from the first's final checkpoint (orbax restore path) and
+    continue with a healthy constraint."""
+    ckpt = str(tmp_path / "ckpt")
+    run_example(
+        "scalar_preheating.py", "-grid", "16", "16", "16", "-end-t", "0.4",
+        "--checkpoint-dir", ckpt, "--checkpoint-interval", "10",
+        "--outfile", str(tmp_path / "first"))
+    stdout = run_example(
+        "scalar_preheating.py", "-grid", "16", "16", "16", "-end-t", "0.8",
+        "--checkpoint-dir", ckpt, "--checkpoint-interval", "10",
+        "--outfile", str(tmp_path / "second"))
+    assert "Resumed from checkpoint" in stdout
+    line = [ln for ln in stdout.splitlines() if "final constraint" in ln][-1]
+    assert float(line.split()[-1]) < 1e-4
