@@ -32,11 +32,14 @@ class Lattice:
     dtype: np.dtype = np.float32
 
     def __post_init__(self):
-        object.__setattr__(self, "grid_shape", tuple(int(n) for n in self.grid_shape))
+        object.__setattr__(self, "grid_shape",
+                           tuple(int(n) for n in self.grid_shape))
         if self.box_dim is None:
-            object.__setattr__(self, "box_dim", tuple(1.0 for _ in self.grid_shape))
+            object.__setattr__(self, "box_dim",
+                               tuple(1.0 for _ in self.grid_shape))
         else:
-            object.__setattr__(self, "box_dim", tuple(float(b) for b in self.box_dim))
+            object.__setattr__(self, "box_dim",
+                               tuple(float(b) for b in self.box_dim))
         if len(self.box_dim) != len(self.grid_shape):
             raise ValueError("box_dim and grid_shape must have equal length")
 
