@@ -93,13 +93,17 @@ def sync(tree):
 # headline: fused preheating step
 # ---------------------------------------------------------------------------
 
-def _resolve_fused(fused):
+def _resolve_fused(fused, grid_shape=None):
     """"auto" -> fused Pallas stages on TPU only; on CPU they would run
     in interpret mode (~100x slower than the XLA path) and misrepresent
-    the framework."""
+    the framework. Compiled kernels also require a lane-aligned z axis
+    (``Z % 128 == 0`` — pallas_stencil.LANE); smaller grids take the XLA
+    halo path."""
     if fused == "auto":
         import jax
-        return jax.default_backend() == "tpu"
+        from pystella_tpu.ops.pallas_stencil import LANE
+        lane_ok = grid_shape is None or grid_shape[-1] % LANE == 0
+        return jax.default_backend() == "tpu" and lane_ok
     return fused
 
 
@@ -108,7 +112,7 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
     import jax
     import pystella_tpu as ps
 
-    fused = _resolve_fused(fused)
+    fused = _resolve_fused(fused, grid_shape)
 
     lattice = ps.Lattice(grid_shape, (5.0, 5.0, 5.0), dtype=dtype)
     dt = dtype(0.1 * min(lattice.dx))
@@ -159,7 +163,7 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
 
 def run_preheat(n, nsteps=10, nwarmup=2, dtype=np.float32, fused="auto"):
     grid_shape = (n, n, n)
-    fused = _resolve_fused(fused)
+    fused = _resolve_fused(fused, grid_shape)
     label = "fused" if fused else "generic"
     hb(f"{n}^3 ({label}): building model")
     step, state, dt = build_preheat_step(grid_shape, dtype, fused=fused)
@@ -247,7 +251,7 @@ def run_gw_spectra(n=256, nreps=5):
     return (time.perf_counter() - start) / nreps * 1e3
 
 
-def run_pallas_parity(n=64, dtype=np.float32):
+def run_pallas_parity(n=128, dtype=np.float32):
     """On-hardware proof of the Mosaic-compiled Pallas path: one fused
     (Pallas) step vs one generic (XLA) step from identical states; returns
     the max relative state difference (fp-roundoff-sized when the compiled
@@ -445,7 +449,7 @@ def payload(platform_wanted):
         # budget timeout and would pollute subsequent timings)
         try:
             maxrel = bounded(run_pallas_parity, budget, "pallas-parity")
-            emit("pallas-compiled parity maxrel (fused vs XLA, 64^3 f32)",
+            emit("pallas-compiled parity maxrel (fused vs XLA, 128^3 f32)",
                  maxrel, "max rel diff", None)
             hb(f"pallas parity: maxrel={maxrel:.3e}")
         except Exception as e:
@@ -458,16 +462,19 @@ def payload(platform_wanted):
                                     "64" if platform == "cpu" else "256"))
         mg_n = int(os.environ.get("BENCH_MG_N",
                                   "64" if platform == "cpu" else "512"))
-        for label, fn, unit, base in [
+        # multigrid's many-level V-cycle is compile-heavy: ~365 s of XLA
+        # compile at 512^3 on v5e (measured), so it gets a doubled budget
+        for label, fn, unit, base, cfg_budget in [
                 (f"wave-{wave_n}^3{suffix}",
-                 lambda: run_wave(wave_n), "site-updates/s", 1e9),
+                 lambda: run_wave(wave_n), "site-updates/s", 1e9, budget),
                 (f"gw-spectra-{spec_n}^3{suffix}",
-                 lambda: run_gw_spectra(spec_n), "ms/call", None),
+                 lambda: run_gw_spectra(spec_n), "ms/call", None, budget),
                 (f"multigrid-{mg_n}^3{suffix}",
-                 lambda: run_multigrid(mg_n), "ms/V-cycle", None)]:
+                 lambda: run_multigrid(mg_n), "ms/V-cycle", None,
+                 2 * budget)]:
             try:
                 hb(f"extra config: {label}")
-                val = bounded(fn, budget, label)
+                val = bounded(fn, cfg_budget, label)
             except Exception as e:
                 hb(f"{label} FAILED: {type(e).__name__}: {e}")
                 traceback.print_exc()

@@ -40,11 +40,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["StreamingStencil", "Taps", "HY", "choose_blocks",
+__all__ = ["StreamingStencil", "Taps", "HY", "LANE", "choose_blocks",
            "lap_from_taps", "grad_from_taps"]
 
 #: aligned y-halo width (one sublane tile); must be >= the stencil radius
 HY = 8
+
+#: Mosaic lane-tile width: the windowed HBM->VMEM ``async_copy`` requires
+#: the trailing (lane) dimension of every slice to be a multiple of 128,
+#: even when the slice spans the whole axis (measured on v5e: a
+#: ``(C, bx, by, 64)`` window DMA fails to compile with "Slice shape along
+#: dimension 3 must be aligned to tiling (128)"). Compiled kernels
+#: therefore require ``Z % LANE == 0``; callers fall back to the XLA halo
+#: path for smaller lattices.
+LANE = 128
 
 _RING = 4  # x-block ring slots: 3 live + 1 in flight
 
@@ -213,6 +222,12 @@ class StreamingStencil:
         self.bx, self.by = int(bx), int(by)
         self.x_halo = bool(x_halo)
         self.interpret = _is_cpu() if interpret is None else interpret
+        if not self.interpret and Z % LANE:
+            raise ValueError(
+                f"compiled streaming stencils require the z axis to be a "
+                f"multiple of the {LANE}-lane tile (got Z={Z}): Mosaic "
+                f"rejects windowed DMAs with unaligned lane slices; use "
+                f"the halo/roll path (or interpret mode) for this lattice")
         self._calls = [self._build(j) for j in range(Y // self.by)]
 
     # -- construction ------------------------------------------------------

@@ -10,6 +10,15 @@ import pytest
 import pystella_tpu as ps
 from pystella_tpu.ops.fused import FusedPreheatStepper, FusedScalarStepper
 
+# Small-grid bodies run the Pallas stages in interpret mode (f64,
+# bit-exact vs the generic stepper); compiled Mosaic kernels require
+# Z % 128 == 0 and f32 — the on-device check is bench.py's pallas-parity
+# config (fused vs XLA at 128^3 f32).
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="interpret-mode f64 bodies on sub-lane-tile grids; compiled "
+           "coverage: bench.py pallas-parity at 128^3")
+
 
 @pytest.fixture
 def decomp():
