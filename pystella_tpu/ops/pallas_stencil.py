@@ -68,16 +68,25 @@ def _rem(a, m):
 
 
 def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
-                  budget=10 * 2**20):
+                  budget=24 * 2**20):
     """Pick ``(bx, by)`` fitting the VMEM budget: the window ring, the
     double-buffered extra inputs / outputs, and ~3 window-sized compute
-    temporaries."""
+    temporaries.
+
+    Preference (measured on v5e, 512^3/128^3 fused RK54 sweeps): the
+    largest feasible ``by`` (fewer per-stage pallas_calls, wider DMA
+    rows), then the *smallest* feasible ``bx >= h`` — small x-blocks keep
+    the ring slots cheap and pipeline best ((2,128) beat every bx>=4
+    blocking at 128^3; (2,64) beat (2,32) at 512^3). The 24 MB budget is
+    the largest for which every selected blocking has been observed to
+    pass Mosaic's VMEM allocator at 512^3 (a (2,128)/45 MB-estimate
+    blocking fails to compile there)."""
     X, Y, Z = lattice_shape
     best = None
     for by in (256, 128, 64, 32, 16, 8):
         if by > Y or Y % by:
             continue
-        for bx in (16, 8, 4, 2, 1):
+        for bx in (1, 2, 4, 8, 16):
             if bx > X or X % bx or bx < h:
                 continue
             byw = by + 2 * HY
@@ -85,8 +94,10 @@ def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
             temps = 3 * n_comp * (bx + 2 * h) * byw * Z * itemsize
             io = 2 * (n_extra + n_out) * bx * by * Z * itemsize
             if win + temps + io <= budget:
-                if best is None or bx * by > best[0] * best[1]:
-                    best = (bx, by)
+                best = (bx, by)
+                break  # smallest feasible bx for this by
+        if best is not None:
+            break  # largest feasible by wins
     if best is None:
         if Y % 8:
             # the streaming kernel's y-slab math assumes by >= the 8-aligned
