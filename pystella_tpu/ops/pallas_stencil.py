@@ -137,15 +137,20 @@ class Taps:
         if sz != 0:
             if sx or sy:
                 raise ValueError("taps must be axis-aligned")
-            c = self()
-            if self._interpret:
-                out = jnp.roll(c, -sz, axis=3)
-            else:
-                out = pltpu.roll(c, (Z - sz) % Z, 3)
+            out = self.roll(self(), sz)
         else:
             out = self._w[:, h + sx:h + sx + bx, HY + sy:HY + sy + by, :]
         self._cache[key] = out
         return out
+
+    def roll(self, arr, sz):
+        """Periodic z-shift of a *computed* ``(C, bx, by, Z)`` block with
+        the same lowering as z taps (in-register lane roll when compiled;
+        used by bodies that take stencil taps of derived quantities, e.g.
+        the stage-pair kernel's Laplacian of the intermediate field)."""
+        if self._interpret:
+            return jnp.roll(arr, -sz, axis=3)
+        return pltpu.roll(arr, (self._Z - sz) % self._Z, 3)
 
 
 def lap_from_taps(taps, coefs, inv_dx2):
