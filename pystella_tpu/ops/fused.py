@@ -240,8 +240,26 @@ class FusedScalarStepper(_step.Stepper):
                 fv.shape[1:])
             for e in self._dvdf])
 
-    def _pair_body(self, taps, extras, scalars):
-        """Two consecutive 2N-storage RK stages in one pass over HBM."""
+    @staticmethod
+    def _axpy_taps(t_y, t_k, t_dy, B, A, dt, y1):
+        """Taps-like view of a 2N stage-updated array
+        ``y1 = y + B*(A*k + dt*dy)`` without materializing its halo: x/y
+        shifts compose from the raw windows at the same offsets (the
+        identical arithmetic as slicing a materialized y1), z shifts are
+        in-register rolls of the block value ``y1`` itself."""
+        def taps(sx=0, sy=0, sz=0):
+            if sz:
+                return t_y.roll(y1, sz)
+            if sx == 0 and sy == 0:
+                return y1
+            return (t_y(sx, sy)
+                    + B * (A * t_k(sx, sy) + dt * t_dy(sx, sy)))
+        return taps
+
+    def _scalar_pair_core(self, taps, extras, scalars):
+        """Two consecutive 2N-storage scalar stages in one HBM pass;
+        returns the four outputs plus the stage-1 field's composed taps
+        (for subclasses that differentiate the intermediate field)."""
         tf, tdf, tkf = taps["f"], taps["dfdt"], taps["kf"]
         kdf0 = extras["kdfdt"]
         inv_dx2 = [1.0 / d**2 for d in self.dx]
@@ -261,18 +279,7 @@ class FusedScalarStepper(_step.Stepper):
                                  - a1 * a1 * self._dV(f0, a1, hub1))
         df1 = df0 + B1 * kdf1
 
-        # Laplacian of the stage-1 field: f1 is a pointwise axpy of
-        # (f, kf, dfdt), so its x/y taps compose from the raw windows at
-        # the same offsets (the identical arithmetic as materializing f1
-        # and slicing it); z taps are in-register rolls of f1 itself
-        def f1_taps(sx=0, sy=0, sz=0):
-            if sz:
-                return tf.roll(f1, sz)
-            if sx == 0 and sy == 0:
-                return f1
-            return (tf(sx, sy)
-                    + B1 * (A1 * tkf(sx, sy) + dt * tdf(sx, sy)))
-
+        f1_taps = self._axpy_taps(tf, tkf, tdf, B1, A1, dt, f1)
         lap_f1 = _lap_from_taps(f1_taps, coefs, inv_dx2)
 
         # stage 2 on the block
@@ -281,7 +288,13 @@ class FusedScalarStepper(_step.Stepper):
         kdf2 = A2 * kdf1 + dt * (lap_f1 - 2 * hub2 * df1
                                  - a2 * a2 * self._dV(f1, a2, hub2))
         df2 = df1 + B2 * kdf2
-        return {"f": f2, "dfdt": df2, "kf": kf2, "kdfdt": kdf2}
+        outs = {"f": f2, "dfdt": df2, "kf": kf2, "kdfdt": kdf2}
+        return outs, f1_taps
+
+    def _pair_body(self, taps, extras, scalars):
+        """Two consecutive 2N-storage RK stages in one pass over HBM."""
+        outs, _ = self._scalar_pair_core(taps, extras, scalars)
+        return outs
 
     # -- Stepper interface -------------------------------------------------
 
@@ -310,21 +323,24 @@ class FusedScalarStepper(_step.Stepper):
         return ({"f": outs["f"], "dfdt": outs["dfdt"]},
                 {"f": outs["kf"], "dfdt": outs["kdfdt"]})
 
+    def _pair_scalars(self, s, dt, rhs_args, rhs_args2=None):
+        args2 = rhs_args2 if rhs_args2 is not None else rhs_args
+        return {"dt": dt,
+                "a1": rhs_args.get("a", 1.0),
+                "hubble1": rhs_args.get("hubble", 0.0),
+                "A1": self._A[s], "B1": self._B[s],
+                "a2": args2.get("a", 1.0),
+                "hubble2": args2.get("hubble", 0.0),
+                "A2": self._A[s + 1], "B2": self._B[s + 1]}
+
     def stage_pair(self, s, carry, t, dt, rhs_args, rhs_args2=None):
         """Run stages ``s`` and ``s+1`` as one fused kernel.
         ``rhs_args2`` supplies stage-(s+1) expansion scalars when the
         caller advances them between stages (defaults to ``rhs_args``)."""
         state, k = carry
-        args2 = rhs_args2 if rhs_args2 is not None else rhs_args
         outs = self._pair_call(
             {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"]},
-            {"dt": dt,
-             "a1": rhs_args.get("a", 1.0),
-             "hubble1": rhs_args.get("hubble", 0.0),
-             "A1": self._A[s], "B1": self._B[s],
-             "a2": args2.get("a", 1.0),
-             "hubble2": args2.get("hubble", 0.0),
-             "A2": self._A[s + 1], "B2": self._B[s + 1]},
+            self._pair_scalars(s, dt, rhs_args, rhs_args2),
             {"kdfdt": k["dfdt"]})
         return ({"f": outs["f"], "dfdt": outs["dfdt"]},
                 {"f": outs["kf"], "dfdt": outs["kdfdt"]})
@@ -397,6 +413,43 @@ class FusedPreheatStepper(FusedScalarStepper):
             self._both_st, windows=("f", "hij"),
             extra_names=("dfdt", "kf", "kdfdt",
                          "dhijdt", "khij", "kdhijdt"))
+        if self._pair_stages:
+            # stage-pair kernel for the full system: every array whose
+            # stage-1 update is differentiated in stage 2 rides a ring
+            # window (f/dfdt/kf feed lap+grad of f1; hij/dhijdt/khij feed
+            # lap of h1); the k-derivative carries are offset-0 only and
+            # stay blockwise extras
+            self._pair_st = StreamingStencil(
+                self.local_shape,
+                {"f": F, "dfdt": F, "kf": F,
+                 "hij": H, "dhijdt": H, "khij": H}, self.h,
+                self._pair_body, out_defs={
+                    "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+                    "hij": (H,), "dhijdt": (H,), "khij": (H,),
+                    "kdhijdt": (H,)},
+                extra_defs={"kdfdt": (F,), "kdhijdt": (H,)},
+                scalar_names=("dt", "a1", "hubble1", "A1", "B1",
+                              "a2", "hubble2", "A2", "B2"),
+                dtype=self.dtype, bx=self._pair_bx, by=self._pair_by,
+                x_halo=(self._px > 1))
+            self._pair_call = self._make_call(
+                self._pair_st,
+                windows=("f", "dfdt", "kf", "hij", "dhijdt", "khij"),
+                extra_names=("kdfdt", "kdhijdt"))
+
+    def _sij_eval(self, ftaps_like, a, hub, dtype, shape):
+        """Evaluate the symbolic anisotropic-stress components from field
+        gradients taken through ``ftaps_like`` (raw window taps or a
+        composed intermediate-field view)."""
+        inv_dx = [1.0 / d for d in self.dx]
+        grads = _grad_from_taps(ftaps_like, _grad_coefs[self.h], inv_dx)
+        dfdx = jnp.stack(grads, axis=1)  # (F, 3, bx, by, Z)
+        env = {"dfdx": dfdx, "a": a, "hubble": hub}
+        return jnp.stack([
+            jnp.broadcast_to(
+                jnp.asarray(_field.evaluate(self._sij[c], env), dtype),
+                shape)
+            for c in range(self.n_hij)])
 
     def _preheat_body(self, taps, extras, scalars):
         ftaps, htaps = taps["f"], taps["hij"]
@@ -406,23 +459,13 @@ class FusedPreheatStepper(FusedScalarStepper):
             ftaps, {n: extras[n] for n in ("dfdt", "kf", "kdfdt")}, scalars)
 
         inv_dx2 = [1.0 / d**2 for d in self.dx]
-        inv_dx = [1.0 / d for d in self.dx]
         lap_coefs = _lap_coefs[self.h]
-        grad_coefs = _grad_coefs[self.h]
         dt, a, hub = scalars["dt"], scalars["a"], scalars["hubble"]
         A, B = scalars["A"], scalars["B"]
 
         hint = htaps()
         lap_h = _lap_from_taps(htaps, lap_coefs, inv_dx2)
-        grads = _grad_from_taps(ftaps, grad_coefs, inv_dx)  # 3 x (F,...)
-        dfdx = jnp.stack(grads, axis=1)  # (F, 3, bx, by, Z)
-
-        env = {"dfdx": dfdx, "a": a, "hubble": hub}
-        sij = jnp.stack([
-            jnp.broadcast_to(
-                jnp.asarray(_field.evaluate(self._sij[c], env), hint.dtype),
-                hint.shape[1:])
-            for c in range(self.n_hij)])
+        sij = self._sij_eval(ftaps, a, hub, hint.dtype, hint.shape[1:])
 
         dh, kh, kdh = extras["dhijdt"], extras["khij"], extras["kdhijdt"]
         rhs_h = dh
@@ -434,6 +477,61 @@ class FusedPreheatStepper(FusedScalarStepper):
         dh2 = dh + B * kdh2
         return {**souts,
                 "hij": h2, "dhijdt": dh2, "khij": kh2, "kdhijdt": kdh2}
+
+    def _pair_body(self, taps, extras, scalars):
+        """Two consecutive stages of the full scalar+GW system in one
+        pass over HBM (same composition rule as the scalar pair: the
+        stage-1 fields are pointwise axpys of windowed arrays, so their
+        Laplacians/gradients come from the same taps)."""
+        souts, f1_taps = self._scalar_pair_core(taps, extras, scalars)
+
+        th, tdh, tkh = taps["hij"], taps["dhijdt"], taps["khij"]
+        kdh0 = extras["kdhijdt"]
+        inv_dx2 = [1.0 / d**2 for d in self.dx]
+        lap_coefs = _lap_coefs[self.h]
+        dt = scalars["dt"]
+        a1, hub1 = scalars["a1"], scalars["hubble1"]
+        A1, B1 = scalars["A1"], scalars["B1"]
+        a2, hub2 = scalars["a2"], scalars["hubble2"]
+        A2, B2 = scalars["A2"], scalars["B2"]
+
+        # stage 1 (identical arithmetic to _preheat_body)
+        h0, dh0 = th(), tdh()
+        lap_h = _lap_from_taps(th, lap_coefs, inv_dx2)
+        sij1 = self._sij_eval(taps["f"], a1, hub1, h0.dtype, h0.shape[1:])
+        kh1 = A1 * tkh() + dt * dh0
+        h1 = h0 + B1 * kh1
+        kdh1 = A1 * kdh0 + dt * (lap_h - 2 * hub1 * dh0
+                                 + 16 * np.pi * sij1)
+        dh1 = dh0 + B1 * kdh1
+
+        h1_taps = self._axpy_taps(th, tkh, tdh, B1, A1, dt, h1)
+        lap_h1 = _lap_from_taps(h1_taps, lap_coefs, inv_dx2)
+        sij2 = self._sij_eval(f1_taps, a2, hub2, h0.dtype, h0.shape[1:])
+
+        # stage 2
+        kh2 = A2 * kh1 + dt * dh1
+        h2 = h1 + B2 * kh2
+        kdh2 = A2 * kdh1 + dt * (lap_h1 - 2 * hub2 * dh1
+                                 + 16 * np.pi * sij2)
+        dh2 = dh1 + B2 * kdh2
+        return {**souts,
+                "hij": h2, "dhijdt": dh2, "khij": kh2, "kdhijdt": kdh2}
+
+    def stage_pair(self, s, carry, t, dt, rhs_args, rhs_args2=None):
+        """Run stages ``s`` and ``s+1`` of the scalar+GW system as one
+        fused kernel (see :meth:`FusedScalarStepper.stage_pair`)."""
+        state, k = carry
+        outs = self._pair_call(
+            {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"],
+             "hij": state["hij"], "dhijdt": state["dhijdt"],
+             "khij": k["hij"]},
+            self._pair_scalars(s, dt, rhs_args, rhs_args2),
+            {"kdfdt": k["dfdt"], "kdhijdt": k["dhijdt"]})
+        return ({"f": outs["f"], "dfdt": outs["dfdt"],
+                 "hij": outs["hij"], "dhijdt": outs["dhijdt"]},
+                {"f": outs["kf"], "dfdt": outs["kdfdt"],
+                 "hij": outs["khij"], "dhijdt": outs["kdhijdt"]})
 
     def stage(self, s, carry, t, dt, rhs_args):
         state, k = carry
