@@ -268,6 +268,52 @@ def run_gw_spectra(n=256, nreps=5):
     return (time.perf_counter() - start) / nreps * 1e3
 
 
+def run_gw_step(n=256, nsteps=5, dtype=np.float32):
+    """Full scalar+GW preheating step (FusedPreheatStepper, stage-pair
+    kernels on TPU): the BASELINE 'GW tensor sector' stepping config, and
+    the on-device compile proof for the 24-component pair kernel."""
+    import jax
+    import pystella_tpu as ps
+
+    grid_shape = (n, n, n)
+    lattice = ps.Lattice(grid_shape, (5.0,) * 3, dtype=dtype)
+    dt = dtype(0.1 * min(lattice.dx))
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+
+    def potential(f):
+        return 0.5 * 1.2e-2 * f[0]**2 + 0.125 * f[0]**2 * f[1]**2
+
+    sector = ps.ScalarSector(2, potential=potential)
+    gw = ps.TensorPerturbationSector([sector])
+    stepper = ps.FusedPreheatStepper(sector, gw, decomp, grid_shape,
+                                     lattice.dx, 2, dtype=dtype, dt=dt)
+    args = {"a": dtype(1.0), "hubble": dtype(0.1)}
+
+    def chunk(st):
+        def body(carry, _):
+            return stepper.step(carry, 0.0, dt, args), None
+        st, _ = jax.lax.scan(body, st, xs=None, length=nsteps)
+        return st
+
+    chunk = jax.jit(chunk, donate_argnums=0)
+
+    rng = np.random.default_rng(9)
+    state = {
+        "f": decomp.shard(
+            0.1 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
+        "dfdt": decomp.shard(
+            0.01 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
+        "hij": decomp.zeros(grid_shape, dtype, outer_shape=(6,)),
+        "dhijdt": decomp.zeros(grid_shape, dtype, outer_shape=(6,)),
+    }
+    state = chunk(state)
+    sync(state)
+    start = time.perf_counter()
+    state = chunk(state)
+    sync(state)
+    return float(n) ** 3 * nsteps / (time.perf_counter() - start)
+
+
 def run_pallas_parity(n=128, dtype=np.float32):
     """On-hardware proof of the Mosaic-compiled Pallas path: one fused
     (Pallas) step vs one generic (XLA) step from identical states; returns
@@ -482,14 +528,22 @@ def payload(platform_wanted):
                                   "64" if platform == "cpu" else "512"))
         # multigrid's many-level V-cycle is compile-heavy: ~365 s of XLA
         # compile at 512^3 on v5e (measured), so it gets a doubled budget
-        for label, fn, unit, base, cfg_budget in [
-                (f"wave-{wave_n}^3{suffix}",
-                 lambda: run_wave(wave_n), "site-updates/s", 1e9, budget),
-                (f"gw-spectra-{spec_n}^3{suffix}",
-                 lambda: run_gw_spectra(spec_n), "ms/call", None, budget),
-                (f"multigrid-{mg_n}^3{suffix}",
-                 lambda: run_multigrid(mg_n), "ms/V-cycle", None,
-                 2 * budget)]:
+        configs = [
+            (f"wave-{wave_n}^3{suffix}",
+             lambda: run_wave(wave_n), "site-updates/s", 1e9, budget),
+            (f"gw-spectra-{spec_n}^3{suffix}",
+             lambda: run_gw_spectra(spec_n), "ms/call", None, budget),
+            (f"multigrid-{mg_n}^3{suffix}",
+             lambda: run_multigrid(mg_n), "ms/V-cycle", None,
+             2 * budget)]
+        if platform == "tpu":
+            # compiled-only config (the 24-component pair kernel would
+            # run in interpret mode on CPU — pointlessly slow)
+            gw_n = int(os.environ.get("BENCH_GW_N", "256"))
+            configs.insert(2, (
+                f"gw-step-{gw_n}^3", lambda: run_gw_step(gw_n),
+                "site-updates/s", 1e9, budget))
+        for label, fn, unit, base, cfg_budget in configs:
             try:
                 hb(f"extra config: {label}")
                 val = bounded(fn, cfg_budget, label)

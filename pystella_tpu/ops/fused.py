@@ -246,14 +246,24 @@ class FusedScalarStepper(_step.Stepper):
         ``y1 = y + B*(A*k + dt*dy)`` without materializing its halo: x/y
         shifts compose from the raw windows at the same offsets (the
         identical arithmetic as slicing a materialized y1), z shifts are
-        in-register rolls of the block value ``y1`` itself."""
+        in-register rolls of the block value ``y1`` itself. Memoized like
+        ``Taps`` so consumers sharing offsets (lap + grad) reuse the
+        composed expressions."""
+        cache = {}
+
         def taps(sx=0, sy=0, sz=0):
+            key = (sx, sy, sz)
+            if key in cache:
+                return cache[key]
             if sz:
-                return t_y.roll(y1, sz)
-            if sx == 0 and sy == 0:
-                return y1
-            return (t_y(sx, sy)
-                    + B * (A * t_k(sx, sy) + dt * t_dy(sx, sy)))
+                out = t_y.roll(y1, sz)
+            elif sx == 0 and sy == 0:
+                out = y1
+            else:
+                out = (t_y(sx, sy)
+                       + B * (A * t_k(sx, sy) + dt * t_dy(sx, sy)))
+            cache[key] = out
+            return out
         return taps
 
     def _scalar_pair_core(self, taps, extras, scalars):
@@ -437,6 +447,18 @@ class FusedPreheatStepper(FusedScalarStepper):
                 windows=("f", "dfdt", "kf", "hij", "dhijdt", "khij"),
                 extra_names=("kdfdt", "kdhijdt"))
 
+    @staticmethod
+    def _gw_stage(h0, dh0, kh0, kdh0, lap_h, sij, A, B, dt, hub):
+        """One 2N-storage tensor-sector stage (the identical arithmetic
+        sequence everywhere it appears: single-stage body and both halves
+        of the pair body)."""
+        kh1 = A * kh0 + dt * dh0
+        h1 = h0 + B * kh1
+        kdh1 = A * kdh0 + dt * (lap_h - 2 * hub * dh0
+                                + 16 * np.pi * sij)
+        dh1 = dh0 + B * kdh1
+        return h1, dh1, kh1, kdh1
+
     def _sij_eval(self, ftaps_like, a, hub, dtype, shape):
         """Evaluate the symbolic anisotropic-stress components from field
         gradients taken through ``ftaps_like`` (raw window taps or a
@@ -468,13 +490,8 @@ class FusedPreheatStepper(FusedScalarStepper):
         sij = self._sij_eval(ftaps, a, hub, hint.dtype, hint.shape[1:])
 
         dh, kh, kdh = extras["dhijdt"], extras["khij"], extras["kdhijdt"]
-        rhs_h = dh
-        rhs_dh = lap_h - 2 * hub * dh + 16 * np.pi * sij
-
-        kh2 = A * kh + dt * rhs_h
-        h2 = hint + B * kh2
-        kdh2 = A * kdh + dt * rhs_dh
-        dh2 = dh + B * kdh2
+        h2, dh2, kh2, kdh2 = self._gw_stage(
+            hint, dh, kh, kdh, lap_h, sij, A, B, dt, hub)
         return {**souts,
                 "hij": h2, "dhijdt": dh2, "khij": kh2, "kdhijdt": kdh2}
 
@@ -499,22 +516,16 @@ class FusedPreheatStepper(FusedScalarStepper):
         h0, dh0 = th(), tdh()
         lap_h = _lap_from_taps(th, lap_coefs, inv_dx2)
         sij1 = self._sij_eval(taps["f"], a1, hub1, h0.dtype, h0.shape[1:])
-        kh1 = A1 * tkh() + dt * dh0
-        h1 = h0 + B1 * kh1
-        kdh1 = A1 * kdh0 + dt * (lap_h - 2 * hub1 * dh0
-                                 + 16 * np.pi * sij1)
-        dh1 = dh0 + B1 * kdh1
+        h1, dh1, kh1, kdh1 = self._gw_stage(
+            h0, dh0, tkh(), kdh0, lap_h, sij1, A1, B1, dt, hub1)
 
         h1_taps = self._axpy_taps(th, tkh, tdh, B1, A1, dt, h1)
         lap_h1 = _lap_from_taps(h1_taps, lap_coefs, inv_dx2)
         sij2 = self._sij_eval(f1_taps, a2, hub2, h0.dtype, h0.shape[1:])
 
         # stage 2
-        kh2 = A2 * kh1 + dt * dh1
-        h2 = h1 + B2 * kh2
-        kdh2 = A2 * kdh1 + dt * (lap_h1 - 2 * hub2 * dh1
-                                 + 16 * np.pi * sij2)
-        dh2 = dh1 + B2 * kdh2
+        h2, dh2, kh2, kdh2 = self._gw_stage(
+            h1, dh1, kh1, kdh1, lap_h1, sij2, A2, B2, dt, hub2)
         return {**souts,
                 "hij": h2, "dhijdt": dh2, "khij": kh2, "kdhijdt": kdh2}
 
