@@ -583,9 +583,25 @@ def run_payload(platform, timeout):
         [sys.executable, os.path.abspath(__file__), "--payload", platform],
         stdout=subprocess.PIPE, stderr=sys.stderr, text=True, bufsize=1)
     relayed = 0
-    deadline = time.time() + timeout
+    # arm the watchdog early enough that the 15 s SIGTERM grace still
+    # finishes inside `timeout` — the budget stays a true ceiling even
+    # when an external harness enforces it with a hard kill
+    deadline = time.time() + max(1.0, timeout - 16.0)
 
     def _kill():
+        # SIGTERM first with a grace period: a hard SIGKILL of a client
+        # holding the device tunnel wedges the tunnel server-side for
+        # 30+ minutes (observed on the axon transport), poisoning the
+        # NEXT bench run; a terminating python process at least closes
+        # its sockets in order
+        try:
+            proc.terminate()
+        except OSError:
+            return
+        for _ in range(15):
+            if proc.poll() is not None:
+                return
+            time.sleep(1.0)
         try:
             proc.kill()
         except OSError:

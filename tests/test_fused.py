@@ -277,6 +277,7 @@ if __name__ == "__main__":
     isize = np.dtype(args.dtype).itemsize
     ms = ps.timer(lambda: fused.step(state, 0.0, dt, rhs_args),
                   ntime=args.ntime)
-    # 8 lattice arrays moved per stage (f,dfdt,kf,kdfdt r+w) x 2 fields
+    # step() pairs stages: 2 pair kernels (8 arrays each) + 1 single
+    # (8 arrays), x 2 fields
     common.report("fused RK54 step", ms,
-                  nbytes=8 * 5 * 2 * nsites * isize, nsites=nsites)
+                  nbytes=(8 * 2 + 8) * 2 * nsites * isize, nsites=nsites)
