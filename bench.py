@@ -491,6 +491,7 @@ def payload(platform_wanted):
         grids = [g for g in grids if g <= 128] or [min(grids)]
         hb(f"cpu: grids reduced to {grids}")
     suffix = "" if platform == "tpu" else f", {platform}"
+    suffix += os.environ.get("BENCH_SUFFIX_EXTRA", "")
 
     largest = None
     for n in sorted(grids):
@@ -643,12 +644,15 @@ def main():
     if os.environ.get("BENCH_CPU_FIRST", "1") != "0" and not force_cpu:
         ins_budget = min(300.0, total_budget - cpu_reserve
                          - (time.time() - T0))
-        if ins_budget >= 60:
+        # the watchdog fires ~16s early, so anything under 120s cannot
+        # fit the 90s per-config budget — skip rather than burn budget
+        if ins_budget >= 120:
             hb("orchestrator: quick CPU insurance number first")
             got_insurance, _ = run_payload(
                 "cpu", ins_budget,
                 {"BENCH_EXTRAS": "0", "BENCH_GRIDS": "128",
-                 "BENCH_CONFIG_BUDGET": "90"})
+                 "BENCH_CONFIG_BUDGET": "90",
+                 "BENCH_SUFFIX_EXTRA": ", insurance"})
 
     got_tpu = 0
     attempt = 0
