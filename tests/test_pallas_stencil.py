@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from pystella_tpu.ops.pallas_stencil import HY, LANE, StreamingStencil
+from pystella_tpu.ops.pallas_stencil import LANE, StreamingStencil
 
 # These bodies verify window/ring/wrap logic bit-exactly (f64, interpret
 # mode) on small grids; compiled Mosaic kernels require Z % LANE == 0 and
