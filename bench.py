@@ -144,14 +144,6 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
 
         stepper = ps.LowStorageRK54(full_rhs, dt=dt)
 
-    def one_step(state, t, dt, a, hubble):
-        # step() is the production whole-step path (stage-pair kernels on
-        # the fused stepper); driving stage() here would silently bench
-        # the single-stage kernels instead
-        return stepper.step(state, t, dt, {"a": a, "hubble": hubble})
-
-    step = jax.jit(one_step, donate_argnums=0)
-
     rng = np.random.default_rng(7)
     state = {
         "f": decomp.shard(
@@ -159,7 +151,7 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
         "dfdt": decomp.shard(
             0.01 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
     }
-    return step, state, dt
+    return stepper, state, dt
 
 
 def run_preheat(n, nsteps=10, dtype=np.float32, fused="auto"):
@@ -169,20 +161,27 @@ def run_preheat(n, nsteps=10, dtype=np.float32, fused="auto"):
     fused = _resolve_fused(fused, grid_shape)
     label = "fused" if fused else "generic"
     hb(f"{n}^3 ({label}): building model")
-    step, state, dt = build_preheat_step(grid_shape, dtype, fused=fused)
-    t, a, hubble = dtype(0.0), dtype(1.0), dtype(0.5)
+    stepper, state, dt = build_preheat_step(grid_shape, dtype, fused=fused)
+    t = dtype(0.0)
+    args = {"a": dtype(1.0), "hubble": dtype(0.5)}
 
-    # time ``nsteps`` chained on-device via lax.scan — a real driver loop
-    # enqueues steps back-to-back, and the tunneled transport adds ~15 ms
-    # of dispatch latency per host->device call that a per-step python
-    # loop would mis-attribute to the kernels
-    def chunk(st):
-        def body(carry, _):
-            return step(carry, t, dt, a, hubble), None
-        st, _ = jax.lax.scan(body, st, xs=None, length=nsteps)
-        return st
+    # time ``nsteps`` chained on-device in one computation — a real
+    # driver loop enqueues steps back-to-back, and the tunneled
+    # transport adds ~15 ms of dispatch latency per host->device call
+    # that a per-step python loop would mis-attribute to the kernels.
+    # The fused stepper's multi_step additionally pairs stages ACROSS
+    # step boundaries (no odd single-stage kernel at all for RK54).
+    if fused:
+        def chunk(st):
+            return stepper.multi_step(st, nsteps, t, dt, args)
+    else:
+        def chunk(st):
+            def body(carry, _):
+                return stepper.step(carry, t, dt, args), None
+            st, _ = jax.lax.scan(body, st, xs=None, length=nsteps)
+            return st
 
-    chunk = jax.jit(chunk, donate_argnums=0)
+        chunk = jax.jit(chunk, donate_argnums=0)
 
     hb(f"{n}^3 ({label}): compiling + warmup (one {nsteps}-step chunk)")
     state = chunk(state)
@@ -198,12 +197,13 @@ def run_preheat(n, nsteps=10, dtype=np.float32, fused="auto"):
     ups = sites * nsteps / elapsed
     ms = elapsed / nsteps * 1e3
     if fused:
-        # step() pairs stages: 2 pair kernels + 1 single = (8*2+8)
-        # lattice-array transfers x 2 fields per RK54 step (the traffic
-        # model only holds for the fused kernels, so generic-path runs
-        # don't get a bandwidth figure)
-        gbps = (8 * 2 + 8) * sites * 2 * np.dtype(dtype).itemsize \
-            * nsteps / elapsed / 1e9
+        # multi_step pairs across step boundaries: 5*nsteps stages ->
+        # ceil(5*nsteps/2) pair kernels x 8 lattice-array transfers x 2
+        # fields (the traffic model only holds for the fused kernels,
+        # so generic-path runs don't get a bandwidth figure)
+        npairs = -(-stepper.num_stages * nsteps // 2)
+        gbps = 8 * npairs * sites * 2 * np.dtype(dtype).itemsize \
+            / elapsed / 1e9
         bw = f", ~{gbps:.0f} GB/s effective"
     else:
         bw = ""

@@ -45,15 +45,22 @@ def run_mesh(ndev, local_n, nsteps=10, nwarmup=2, dtype=np.float32):
     grid_shape = (local_n * ndev, local_n, local_n)
     decomp = ps.DomainDecomposition((ndev, 1, 1),
                                     devices=jax.devices()[:ndev])
-    step, state, dt = build_preheat_step(grid_shape, dtype, decomp=decomp)
-    t, a, hubble = dtype(0.0), dtype(1.0), dtype(0.5)
+    stepper, state, dt = build_preheat_step(grid_shape, dtype,
+                                            decomp=decomp)
+    t = dtype(0.0)
+    args = {"a": dtype(1.0), "hubble": dtype(0.5)}
+
+    # donate the state so peak HBM stays at one state (stepper.step's
+    # own jit cannot donate: step() callers may reuse their input)
+    step = jax.jit(lambda s: stepper.step(s, t, dt, args),
+                   donate_argnums=0)
 
     for _ in range(nwarmup):
-        state = step(state, t, dt, a, hubble)
+        state = step(state)
     jax.block_until_ready(state)
     start = time.perf_counter()
     for _ in range(nsteps):
-        state = step(state, t, dt, a, hubble)
+        state = step(state)
     jax.block_until_ready(state)
     return (time.perf_counter() - start) / nsteps * 1e3
 

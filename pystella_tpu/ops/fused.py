@@ -119,6 +119,7 @@ class FusedScalarStepper(_step.Stepper):
         # jitted whole-step (one XLA computation, all stages fused)
         import jax
         self._jit_step = jax.jit(self._step_impl)
+        self._jit_multi = {}  # nsteps -> jitted multi_step
 
     def _build_kernels(self, bx, by):
         """Construct this stepper's stage kernel(s). Subclasses override to
@@ -333,7 +334,8 @@ class FusedScalarStepper(_step.Stepper):
         return ({"f": outs["f"], "dfdt": outs["dfdt"]},
                 {"f": outs["kf"], "dfdt": outs["kdfdt"]})
 
-    def _pair_scalars(self, s, dt, rhs_args, rhs_args2=None):
+    def _pair_scalars(self, s, dt, rhs_args, rhs_args2=None, s2=None):
+        s2 = s + 1 if s2 is None else s2
         args2 = rhs_args2 if rhs_args2 is not None else rhs_args
         return {"dt": dt,
                 "a1": rhs_args.get("a", 1.0),
@@ -341,16 +343,20 @@ class FusedScalarStepper(_step.Stepper):
                 "A1": self._A[s], "B1": self._B[s],
                 "a2": args2.get("a", 1.0),
                 "hubble2": args2.get("hubble", 0.0),
-                "A2": self._A[s + 1], "B2": self._B[s + 1]}
+                "A2": self._A[s2], "B2": self._B[s2]}
 
-    def stage_pair(self, s, carry, t, dt, rhs_args, rhs_args2=None):
-        """Run stages ``s`` and ``s+1`` as one fused kernel.
-        ``rhs_args2`` supplies stage-(s+1) expansion scalars when the
-        caller advances them between stages (defaults to ``rhs_args``)."""
+    def stage_pair(self, s, carry, t, dt, rhs_args, rhs_args2=None,
+                   s2=None):
+        """Run stages ``s`` and ``s2`` (default ``s+1``) as one fused
+        kernel. ``rhs_args2`` supplies second-stage expansion scalars
+        when the caller advances them between stages (defaults to
+        ``rhs_args``). ``s2`` may wrap to stage 0 of the NEXT step
+        (every 2N tableau has A[0] == 0, so the k-carry reset at a step
+        boundary is a no-op) — see :meth:`multi_step`."""
         state, k = carry
         outs = self._pair_call(
             {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"]},
-            self._pair_scalars(s, dt, rhs_args, rhs_args2),
+            self._pair_scalars(s, dt, rhs_args, rhs_args2, s2),
             {"kdfdt": k["dfdt"]})
         return ({"f": outs["f"], "dfdt": outs["dfdt"]},
                 {"f": outs["kf"], "dfdt": outs["kdfdt"]})
@@ -366,6 +372,52 @@ class FusedScalarStepper(_step.Stepper):
             carry = self.stage(s, carry, t, dt, rhs_args)
             s += 1
         return self.extract(carry)
+
+    def _multi_step_impl(self, state, nsteps, t, dt, rhs_args):
+        if self._pair_call is None or self._A[0] != 0:
+            # no cross-boundary pairing possible: run plain sequential
+            # steps (each with its own k-carry reset — a tableau with
+            # A[0] != 0 NEEDS the per-step zeros)
+            for _ in range(nsteps):
+                state = self._step_impl(state, t, dt, rhs_args)
+            return state
+        carry = self.init_carry(state)
+        flat = [s for _ in range(nsteps) for s in range(self.num_stages)]
+        i = 0
+        # pair across step boundaries: the stage-0 update multiplies
+        # the stale k-carry by A[0] == 0, so skipping the per-step
+        # zero-reset is bit-exact
+        while i + 1 < len(flat):
+            carry = self.stage_pair(flat[i], carry, t, dt, rhs_args,
+                                    s2=flat[i + 1])
+            i += 2
+        while i < len(flat):
+            carry = self.stage(flat[i], carry, t, dt, rhs_args)
+            i += 1
+        return self.extract(carry)
+
+    def multi_step(self, state, nsteps, t=0.0, dt=None, rhs_args=None):
+        """Advance ``nsteps`` full RK steps as one jitted computation,
+        pairing stages ACROSS step boundaries (fixed ``rhs_args`` —
+        i.e. a frozen expansion background). For RK54's odd stage count
+        this eliminates the single-stage kernel entirely: 10 stages per
+        2 steps = 5 pair kernels, cutting lattice traffic another
+        48 -> 40 transfers per 2 steps vs per-step pairing. Bit-exact
+        vs ``nsteps`` sequential ``step()`` calls.
+
+        The input ``state`` buffers are DONATED (this is the hot-loop
+        driver; donation keeps peak HBM at one state + one carry) — do
+        not reuse ``state`` after the call."""
+        dt = dt if dt is not None else self.dt
+        nsteps = int(nsteps)
+        fn = self._jit_multi.get(nsteps)
+        if fn is None:
+            import functools
+            import jax
+            fn = jax.jit(functools.partial(
+                self._multi_step_impl, nsteps=nsteps), donate_argnums=0)
+            self._jit_multi[nsteps] = fn
+        return fn(state, t=t, dt=dt, rhs_args=rhs_args or {})
 
     def step(self, state, t=0.0, dt=None, rhs_args=None):
         dt = dt if dt is not None else self.dt
@@ -529,15 +581,17 @@ class FusedPreheatStepper(FusedScalarStepper):
         return {**souts,
                 "hij": h2, "dhijdt": dh2, "khij": kh2, "kdhijdt": kdh2}
 
-    def stage_pair(self, s, carry, t, dt, rhs_args, rhs_args2=None):
-        """Run stages ``s`` and ``s+1`` of the scalar+GW system as one
-        fused kernel (see :meth:`FusedScalarStepper.stage_pair`)."""
+    def stage_pair(self, s, carry, t, dt, rhs_args, rhs_args2=None,
+                   s2=None):
+        """Run stages ``s`` and ``s2`` (default ``s+1``) of the
+        scalar+GW system as one fused kernel (see
+        :meth:`FusedScalarStepper.stage_pair`)."""
         state, k = carry
         outs = self._pair_call(
             {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"],
              "hij": state["hij"], "dhijdt": state["dhijdt"],
              "khij": k["hij"]},
-            self._pair_scalars(s, dt, rhs_args, rhs_args2),
+            self._pair_scalars(s, dt, rhs_args, rhs_args2, s2),
             {"kdfdt": k["dfdt"], "kdhijdt": k["dhijdt"]})
         return ({"f": outs["f"], "dfdt": outs["dfdt"],
                  "hij": outs["hij"], "dhijdt": outs["dhijdt"]},
