@@ -68,6 +68,31 @@ class Expansion:
             self.adot = self.dtype.type(current["adot"])
         self.hubble = self.adot / self.a
 
+    def stage_sequence(self, nsteps, energy, pressure, dt):
+        """Advance ``nsteps`` full steps with FROZEN ``(energy, pressure)``,
+        recording the per-stage ``(a, hubble)`` a driver loop would have
+        passed to each field stage (the value *entering* the stage).
+
+        This is the host-side precompute for chunked hot loops
+        (:meth:`FusedScalarStepper.multi_step` ``rhs_seq``): the exact
+        driver re-evaluates the field energy every stage and feeds it
+        back, while a chunk holds the stage-entry energy for ``nsteps``
+        steps — a background-coupling lag of one chunk, acceptable when
+        ``nsteps * dt`` is small against the expansion timescale (the
+        drift is measured in ``tests/test_examples.py``). ``self`` IS
+        advanced to the chunk end. Returns two ``(nsteps * num_stages,)``
+        float arrays ``(a_seq, hubble_seq)``."""
+        ns = self.stepper.num_stages
+        a_seq = np.empty(nsteps * ns, self.dtype)
+        hubble_seq = np.empty(nsteps * ns, self.dtype)
+        i = 0
+        for _ in range(nsteps):
+            for s in range(ns):
+                a_seq[i], hubble_seq[i] = self.a, self.hubble
+                self.step(s, energy, pressure, dt)
+                i += 1
+        return a_seq, hubble_seq
+
     def constraint(self, energy):
         """Dimensionless violation of Friedmann 1 as an evolution constraint
         (reference expansion.py:159-176)."""

@@ -81,7 +81,7 @@ class FusedScalarStepper(_step.Stepper):
     def __init__(self, sector, decomp, grid_shape, dx, halo_shape=2,
                  tableau=None, dtype=jnp.float32, bx=None, by=None,
                  dt=None, pair_stages=True, pair_bx=None, pair_by=None,
-                 **kwargs):
+                 interpret=None, donate=False, **kwargs):
         tableau = tableau or _step.LowStorageRK54
         self._A = tableau._A
         self._B = tableau._B
@@ -108,18 +108,45 @@ class FusedScalarStepper(_step.Stepper):
         self.F = F
         f = sector.f
         V = sector.potential(f)
+        self._V = V
         self._dvdf = [_field.diff(V, f[i]) for i in range(F)]
 
         self.local_shape = decomp.rank_shape(self.grid_shape)
         self._pair_stages = bool(pair_stages) and self.num_stages >= 2
         self._pair_bx, self._pair_by = pair_bx, pair_by
         self._pair_call = None  # set by _build_kernels when pairing
+        self._interpret = interpret
         self._build_kernels(bx, by)
 
-        # jitted whole-step (one XLA computation, all stages fused)
+        # jitted whole-step (one XLA computation, all stages fused).
+        # ``donate=True`` donates the input state buffers (halves the
+        # eager-step peak-HBM footprint; the caller must not reuse the
+        # state afterwards — see doc/performance.md "Memory").
         import jax
-        self._jit_step = jax.jit(self._step_impl)
-        self._jit_multi = {}  # nsteps -> jitted multi_step
+        self._jit_step = jax.jit(
+            self._step_impl, donate_argnums=(0,) if donate else ())
+        self._jit_multi = {}  # (nsteps, seq struct) -> jitted multi_step
+        self._jit_coupled = {}  # (nsteps, grid_size, mpl) -> jitted
+        self._es_call = None  # lazily built energy-emitting stage kernel
+
+    def _try_pair_stencil(self, make):
+        """Build the stage-pair kernel, degrading to single-stage kernels
+        when no blocking of the (much wider) pair window fits the VMEM
+        budget — e.g. the 24-window-component preheat pair at 512**3 —
+        instead of handing Mosaic a config its allocator will reject.
+        Explicitly pinned ``pair_bx``/``pair_by`` are honored verbatim
+        (construction errors then propagate)."""
+        try:
+            return make()
+        except ValueError as e:
+            if self._pair_bx is not None or self._pair_by is not None:
+                raise
+            import warnings
+            warnings.warn(
+                f"stage-pair fusion disabled ({e}); step() will run "
+                "single-stage fused kernels", stacklevel=3)
+            self._pair_stages = False
+            return None
 
     def _build_kernels(self, bx, by):
         """Construct this stepper's stage kernel(s). Subclasses override to
@@ -132,7 +159,8 @@ class FusedScalarStepper(_step.Stepper):
                 "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
             extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
             scalar_names=("dt", "a", "hubble", "A", "B"),
-            dtype=self.dtype, bx=bx, by=by, x_halo=(self._px > 1))
+            dtype=self.dtype, bx=bx, by=by, x_halo=(self._px > 1),
+            interpret=self._interpret)
         self._scalar_call = self._make_call(
             self._scalar_st, windows=("f",),
             extra_names=("dfdt", "kf", "kdfdt"))
@@ -151,7 +179,7 @@ class FusedScalarStepper(_step.Stepper):
             # kernel's VMEM footprint is ~2x; explicit bx/by apply to the
             # single-stage kernel only — use pair_bx/pair_by to pin this
             # one).
-            self._pair_st = StreamingStencil(
+            self._pair_st = self._try_pair_stencil(lambda: StreamingStencil(
                 self.local_shape,
                 {"f": F, "dfdt": F, "kf": F}, self.h,
                 self._pair_body, out_defs={
@@ -160,10 +188,11 @@ class FusedScalarStepper(_step.Stepper):
                 scalar_names=("dt", "a1", "hubble1", "A1", "B1",
                               "a2", "hubble2", "A2", "B2"),
                 dtype=self.dtype, bx=self._pair_bx, by=self._pair_by,
-                x_halo=(self._px > 1))
-            self._pair_call = self._make_call(
-                self._pair_st,
-                windows=("f", "dfdt", "kf"), extra_names=("kdfdt",))
+                x_halo=(self._px > 1), interpret=self._interpret))
+            if self._pair_st is not None:
+                self._pair_call = self._make_call(
+                    self._pair_st,
+                    windows=("f", "dfdt", "kf"), extra_names=("kdfdt",))
 
     def _make_call(self, st, windows, extra_names):
         """Wrap a StreamingStencil in the sharded-x ``shard_map`` (padding
@@ -179,7 +208,7 @@ class FusedScalarStepper(_step.Stepper):
         import jax
         decomp = self.decomp
         h = self.h
-        out_names = list(st.out_defs)
+        out_names = list(st.out_defs) + list(st.sum_defs)
         scalar_names = st.scalar_names
         from jax.sharding import PartitionSpec as P
 
@@ -192,12 +221,15 @@ class FusedScalarStepper(_step.Stepper):
             extras = dict(zip(extra_names, flat[nw + ns:]))
             arg = wins[windows[0]] if nw == 1 else wins
             outs = st(arg, scalars=scalars, extras=extras)
+            for n in st.sum_defs:  # per-shard partials -> global sums
+                outs[n] = decomp.psum(outs[n])
             return tuple(outs[n] for n in out_names)
 
         lat_spec = decomp.spec(1)
         in_specs = ((lat_spec,) * len(windows) + (P(),) * len(scalar_names)
                     + (lat_spec,) * len(extra_names))
-        out_specs = tuple(decomp.spec(1) for _ in out_names)
+        out_specs = (tuple(decomp.spec(1) for _ in st.out_defs)
+                     + (P(),) * len(st.sum_defs))
         sharded = jax.jit(decomp.shard_map(
             body, in_specs, out_specs, check_vma=False))
 
@@ -212,7 +244,7 @@ class FusedScalarStepper(_step.Stepper):
 
     # -- kernel body -------------------------------------------------------
 
-    def _scalar_body(self, taps, extras, scalars):
+    def _scalar_body(self, taps, extras, scalars, energy=False):
         inv_dx2 = [1.0 / d**2 for d in self.dx]
         coefs = _lap_coefs[self.h]
         dt, a, hub = scalars["dt"], scalars["a"], scalars["hubble"]
@@ -231,7 +263,25 @@ class FusedScalarStepper(_step.Stepper):
         f2 = fint + B * kf2
         kdf2 = A * kdf + dt * rhs_df
         df2 = dfdt + B * kdf2
-        return {"f": f2, "dfdt": df2, "kf": kf2, "kdfdt": kdf2}
+        outs = {"f": f2, "dfdt": df2, "kf": kf2, "kdfdt": kdf2}
+        if energy:
+            outs["esums"] = self._esums(fint, dfdt, lap, a, hub)
+        return outs
+
+    def _esums(self, fv, dfdt, lap, a, hub):
+        """Raw energy sums of a stage's ENTRY state, from values already
+        in VMEM (free bandwidth-wise): per component ``sum(dfdt**2)`` and
+        ``sum(-f * lap f)`` (the reducers' integration-by-parts gradient
+        energy, sectors.py reducers), plus ``sum(V(f))`` — the inputs of
+        :func:`~pystella_tpu.models.sectors.get_rho_and_p` up to the
+        ``1/(2 a**2)`` combine factors applied by the coupled driver."""
+        kin = jnp.sum(dfdt * dfdt, axis=(1, 2, 3))
+        grad = jnp.sum(-fv * lap, axis=(1, 2, 3))
+        env = {"f": fv, "a": a, "hubble": hub}
+        pot = jnp.sum(jnp.broadcast_to(
+            jnp.asarray(_field.evaluate(self._V, env), fv.dtype),
+            fv.shape[1:]))
+        return jnp.concatenate([kin, grad, pot.reshape(1)])
 
     def _dV(self, fv, a, hub):
         env = {"f": fv, "a": a, "hubble": hub}
@@ -257,6 +307,8 @@ class FusedScalarStepper(_step.Stepper):
             if key in cache:
                 return cache[key]
             if sz:
+                if sx or sy:  # same contract as Taps.__call__
+                    raise ValueError("taps must be axis-aligned")
                 out = t_y.roll(y1, sz)
             elif sx == 0 and sy == 0:
                 out = y1
@@ -334,6 +386,40 @@ class FusedScalarStepper(_step.Stepper):
         return ({"f": outs["f"], "dfdt": outs["dfdt"]},
                 {"f": outs["kf"], "dfdt": outs["kdfdt"]})
 
+    # -- energy-coupled stages (expansion ODE integrated on device) --------
+
+    def _ensure_energy_call(self):
+        """Build (lazily) the energy-emitting single-stage kernel: the
+        stage kernel plus ``esums`` partial-sum outputs of its ENTRY
+        state — same blocking, same arithmetic, zero extra HBM passes."""
+        if self._es_call is None:
+            F = self.F
+            st = StreamingStencil(
+                self.local_shape, {"f": F}, self.h,
+                lambda t, e, s: self._scalar_body(t, e, s, energy=True),
+                out_defs={
+                    "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
+                extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
+                scalar_names=("dt", "a", "hubble", "A", "B"),
+                dtype=self.dtype, bx=self._scalar_st.bx,
+                by=self._scalar_st.by, x_halo=(self._px > 1),
+                interpret=self._interpret,
+                sum_defs={"esums": 2 * F + 1})
+            self._es_call = self._make_call(
+                st, windows=("f",), extra_names=("dfdt", "kf", "kdfdt"))
+        return self._es_call
+
+    def _stage_energy(self, s, carry, t, dt, rhs_args):
+        """Like :meth:`stage`, additionally returning the raw energy sums
+        of the stage's entry state (see :meth:`_esums`)."""
+        state, k = carry
+        outs = self._es_call(
+            {"f": state["f"]},
+            self._stage_scalars(s, dt, rhs_args),
+            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"]})
+        return (({"f": outs["f"], "dfdt": outs["dfdt"]},
+                 {"f": outs["kf"], "dfdt": outs["kdfdt"]}), outs["esums"])
+
     def _pair_scalars(self, s, dt, rhs_args, rhs_args2=None, s2=None):
         s2 = s + 1 if s2 is None else s2
         args2 = rhs_args2 if rhs_args2 is not None else rhs_args
@@ -345,6 +431,22 @@ class FusedScalarStepper(_step.Stepper):
                 "hubble2": args2.get("hubble", 0.0),
                 "A2": self._A[s2], "B2": self._B[s2]}
 
+    def _check_pair(self, s, s2):
+        """Validate a ``stage_pair`` request: pairing must be enabled, and
+        a wrapped pairing (``s2 < s``, i.e. crossing a step boundary) is
+        only sound when the tableau's stage-``s2`` carry scale is zero —
+        the skipped per-step k-carry reset must be a no-op."""
+        if self._pair_call is None:
+            raise RuntimeError(
+                "stage-pair fusion is not available on this stepper "
+                "(pair_stages=False, a single-stage tableau, or no "
+                "feasible pair-kernel blocking); use stage() or step()")
+        if s2 < s and self._A[s2] != 0:
+            raise ValueError(
+                f"cross-boundary pairing needs A[{s2}] == 0 so the "
+                f"step-boundary k-carry reset is a no-op; this tableau "
+                f"has A[{s2}] = {self._A[s2]}")
+
     def stage_pair(self, s, carry, t, dt, rhs_args, rhs_args2=None,
                    s2=None):
         """Run stages ``s`` and ``s2`` (default ``s+1``) as one fused
@@ -353,6 +455,7 @@ class FusedScalarStepper(_step.Stepper):
         ``rhs_args``). ``s2`` may wrap to stage 0 of the NEXT step
         (every 2N tableau has A[0] == 0, so the k-carry reset at a step
         boundary is a no-op) — see :meth:`multi_step`."""
+        self._check_pair(s, s + 1 if s2 is None else s2)
         state, k = carry
         outs = self._pair_call(
             {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"]},
@@ -373,55 +476,175 @@ class FusedScalarStepper(_step.Stepper):
             s += 1
         return self.extract(carry)
 
-    def _multi_step_impl(self, state, nsteps, t, dt, rhs_args):
+    def _multi_step_impl(self, state, nsteps, t, dt, rhs_args, rhs_seq):
+        nstages = self.num_stages
+
+        def args_at(i):
+            """rhs_args for flat stage index ``i``: static values from
+            ``rhs_args`` overlaid with the i-th entry of each per-stage
+            sequence in ``rhs_seq``."""
+            if not rhs_seq:
+                return rhs_args
+            return {**rhs_args, **{n: v[i] for n, v in rhs_seq.items()}}
+
         if self._pair_call is None or self._A[0] != 0:
-            # no cross-boundary pairing possible: run plain sequential
-            # steps (each with its own k-carry reset — a tableau with
-            # A[0] != 0 NEEDS the per-step zeros)
-            for _ in range(nsteps):
-                state = self._step_impl(state, t, dt, rhs_args)
+            # no cross-boundary pairing possible: sequential steps, each
+            # with its own k-carry reset (a tableau with A[0] != 0 NEEDS
+            # the per-step zeros), pairing within the step when possible
+            for step in range(nsteps):
+                carry = self.init_carry(state)
+                s, base = 0, step * nstages
+                if self._pair_call is not None:
+                    while s + 1 < nstages:
+                        carry = self.stage_pair(
+                            s, carry, t, dt, args_at(base + s),
+                            rhs_args2=args_at(base + s + 1))
+                        s += 2
+                while s < nstages:
+                    carry = self.stage(s, carry, t, dt, args_at(base + s))
+                    s += 1
+                state = self.extract(carry)
             return state
         carry = self.init_carry(state)
-        flat = [s for _ in range(nsteps) for s in range(self.num_stages)]
+        flat = [s for _ in range(nsteps) for s in range(nstages)]
         i = 0
         # pair across step boundaries: the stage-0 update multiplies
         # the stale k-carry by A[0] == 0, so skipping the per-step
         # zero-reset is bit-exact
         while i + 1 < len(flat):
-            carry = self.stage_pair(flat[i], carry, t, dt, rhs_args,
+            carry = self.stage_pair(flat[i], carry, t, dt, args_at(i),
+                                    rhs_args2=args_at(i + 1),
                                     s2=flat[i + 1])
             i += 2
         while i < len(flat):
-            carry = self.stage(flat[i], carry, t, dt, rhs_args)
+            carry = self.stage(flat[i], carry, t, dt, args_at(i))
             i += 1
         return self.extract(carry)
 
-    def multi_step(self, state, nsteps, t=0.0, dt=None, rhs_args=None):
+    def multi_step(self, state, nsteps, t=0.0, dt=None, rhs_args=None,
+                   rhs_seq=None):
         """Advance ``nsteps`` full RK steps as one jitted computation,
-        pairing stages ACROSS step boundaries (fixed ``rhs_args`` —
-        i.e. a frozen expansion background). For RK54's odd stage count
+        pairing stages ACROSS step boundaries. For RK54's odd stage count
         this eliminates the single-stage kernel entirely: 10 stages per
         2 steps = 5 pair kernels, cutting lattice traffic another
         48 -> 40 transfers per 2 steps vs per-step pairing. Bit-exact
-        vs ``nsteps`` sequential ``step()`` calls.
+        vs ``nsteps`` sequential ``step()`` calls with the same
+        per-stage scalars.
+
+        Expansion scalars may evolve across the chunk: ``rhs_seq`` maps
+        scalar names (``"a"``, ``"hubble"``) to arrays of per-stage
+        values, one entry per flat stage (``nsteps * num_stages``),
+        overlaying the static ``rhs_args``. A driver precomputes them on
+        host from the Expansion ODE over the chunk (the background is a
+        cheap scalar integration; see
+        ``examples/scalar_preheating.py --chunk-steps``) — so the hot
+        loop needs no per-stage host dispatch at all.
 
         The input ``state`` buffers are DONATED (this is the hot-loop
         driver; donation keeps peak HBM at one state + one carry) — do
         not reuse ``state`` after the call."""
         dt = dt if dt is not None else self.dt
         nsteps = int(nsteps)
-        fn = self._jit_multi.get(nsteps)
+        if rhs_seq:
+            rhs_seq = {n: jnp.asarray(v) for n, v in rhs_seq.items()}
+            nflat = nsteps * self.num_stages
+            for n, v in rhs_seq.items():
+                if v.shape[0] != nflat:
+                    raise ValueError(
+                        f"rhs_seq[{n!r}] has {v.shape[0]} entries; need "
+                        f"one per stage ({nsteps} steps x "
+                        f"{self.num_stages} stages = {nflat})")
+        key = (nsteps, tuple(sorted(rhs_seq)) if rhs_seq else None)
+        fn = self._jit_multi.get(key)
         if fn is None:
             import functools
             import jax
             fn = jax.jit(functools.partial(
                 self._multi_step_impl, nsteps=nsteps), donate_argnums=0)
-            self._jit_multi[nsteps] = fn
-        return fn(state, t=t, dt=dt, rhs_args=rhs_args or {})
+            self._jit_multi[key] = fn
+        return fn(state, t=t, dt=dt, rhs_args=rhs_args or {},
+                  rhs_seq=rhs_seq or {})
 
     def step(self, state, t=0.0, dt=None, rhs_args=None):
         dt = dt if dt is not None else self.dt
         return self._jit_step(state, t, dt, rhs_args or {})
+
+    # -- energy-coupled chunk driver ---------------------------------------
+
+    def _coupled_impl(self, state, t, dt, a, adot, nsteps, grid_size,
+                      mpl):
+        """``nsteps`` steps with the Friedmann background integrated
+        in-trace, per-stage-exactly coupled: each stage kernel emits the
+        energy sums of its entry state (the quantity the driver loop's
+        per-stage ``compute_energy`` produces), which feed the matching
+        expansion-ODE stage on traced scalars — the same arithmetic
+        sequence as the reference-style driver
+        (examples/scalar_preheating.py stage loop), with zero extra HBM
+        passes and zero host round-trips."""
+        carry = self.init_carry(state)
+        ka = kadot = jnp.zeros_like(a)
+        for _ in range(nsteps):
+            for s in range(self.num_stages):
+                if s == 0:  # fresh expansion k-carry each step, like the
+                    ka = kadot = jnp.zeros_like(a)  # driver's Expansion
+                hubble = adot / a
+                carry, esums = self._stage_energy(
+                    s, carry, t, dt, {"a": a, "hubble": hubble})
+                # combine sums -> (rho, p) with the CURRENT a (matching
+                # compute_energy(..., expand.a) in the driver loop)
+                es = esums.astype(a.dtype)
+                F = self.F
+                inv = 1.0 / (2.0 * a * a * grid_size)
+                kin = jnp.sum(es[:F]) * inv
+                grad = jnp.sum(es[F:2 * F]) * inv
+                pot = es[2 * F] / grid_size
+                rho = kin + grad + pot
+                p = kin - grad / 3.0 - pot
+                # expansion stage s (k = A k + dt rhs; y += B k)
+                addot = (4 * np.pi * a**3 / 3 / mpl**2 * (rho - 3 * p))
+                ka = self._A[s] * ka + dt * adot
+                kadot = self._A[s] * kadot + dt * addot
+                a = a + self._B[s] * ka
+                adot = adot + self._B[s] * kadot
+        return self.extract(carry), a, adot
+
+    def coupled_multi_step(self, state, nsteps, expansion, t=0.0,
+                           dt=None, grid_size=None):
+        """Advance ``nsteps`` steps as ONE jitted computation with the
+        scale factor evolved self-consistently on device — the accurate
+        fast path for expanding-background runs (``--chunk-steps`` with
+        the default coupled mode in ``examples/scalar_preheating.py``).
+
+        Exact per-stage coupling needs each stage's global energy
+        reduction before the next stage's scalars exist, so this path
+        runs single-stage kernels (a global barrier per stage); the
+        stage-pair fusion of :meth:`multi_step` remains the
+        fixed-background bench path. ``expansion`` (an
+        :class:`~pystella_tpu.Expansion`) provides the entry ``(a,
+        adot)`` and is ADVANCED to the chunk end. The input ``state``
+        buffers are donated."""
+        import functools
+        import jax
+        dt = dt if dt is not None else self.dt
+        nsteps = int(nsteps)
+        if grid_size is None:
+            grid_size = float(np.prod(self.grid_shape))
+        mpl = float(expansion.mpl)
+        self._ensure_energy_call()
+        key = (nsteps, grid_size, mpl)
+        fn = self._jit_coupled.get(key)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                self._coupled_impl, nsteps=nsteps, grid_size=grid_size,
+                mpl=mpl), donate_argnums=0)
+            self._jit_coupled[key] = fn
+        state, a, adot = fn(state, t=t, dt=dt,
+                            a=jnp.asarray(float(expansion.a)),
+                            adot=jnp.asarray(float(expansion.adot)))
+        expansion.a = expansion.dtype.type(np.asarray(a))
+        expansion.adot = expansion.dtype.type(np.asarray(adot))
+        expansion.hubble = expansion.adot / expansion.a
+        return state
 
 
 class FusedPreheatStepper(FusedScalarStepper):
@@ -470,7 +693,8 @@ class FusedPreheatStepper(FusedScalarStepper):
             extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,),
                         "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
             scalar_names=("dt", "a", "hubble", "A", "B"),
-            dtype=self.dtype, bx=bx, by=by, x_halo=(self._px > 1))
+            dtype=self.dtype, bx=bx, by=by, x_halo=(self._px > 1),
+            interpret=self._interpret)
         self._both_call = self._make_call(
             self._both_st, windows=("f", "hij"),
             extra_names=("dfdt", "kf", "kdfdt",
@@ -481,7 +705,7 @@ class FusedPreheatStepper(FusedScalarStepper):
             # window (f/dfdt/kf feed lap+grad of f1; hij/dhijdt/khij feed
             # lap of h1); the k-derivative carries are offset-0 only and
             # stay blockwise extras
-            self._pair_st = StreamingStencil(
+            self._pair_st = self._try_pair_stencil(lambda: StreamingStencil(
                 self.local_shape,
                 {"f": F, "dfdt": F, "kf": F,
                  "hij": H, "dhijdt": H, "khij": H}, self.h,
@@ -493,11 +717,12 @@ class FusedPreheatStepper(FusedScalarStepper):
                 scalar_names=("dt", "a1", "hubble1", "A1", "B1",
                               "a2", "hubble2", "A2", "B2"),
                 dtype=self.dtype, bx=self._pair_bx, by=self._pair_by,
-                x_halo=(self._px > 1))
-            self._pair_call = self._make_call(
-                self._pair_st,
-                windows=("f", "dfdt", "kf", "hij", "dhijdt", "khij"),
-                extra_names=("kdfdt", "kdhijdt"))
+                x_halo=(self._px > 1), interpret=self._interpret))
+            if self._pair_st is not None:
+                self._pair_call = self._make_call(
+                    self._pair_st,
+                    windows=("f", "dfdt", "kf", "hij", "dhijdt", "khij"),
+                    extra_names=("kdfdt", "kdhijdt"))
 
     @staticmethod
     def _gw_stage(h0, dh0, kh0, kdh0, lap_h, sij, A, B, dt, hub):
@@ -525,12 +750,15 @@ class FusedPreheatStepper(FusedScalarStepper):
                 shape)
             for c in range(self.n_hij)])
 
-    def _preheat_body(self, taps, extras, scalars):
+    def _preheat_body(self, taps, extras, scalars, energy=False):
         ftaps, htaps = taps["f"], taps["hij"]
 
-        # scalar-system update from the shared f window (inherited body)
+        # scalar-system update from the shared f window (inherited body;
+        # the expansion couples to the scalar-sector energy only, so the
+        # esums come from the f parts — reference driver semantics)
         souts = self._scalar_body(
-            ftaps, {n: extras[n] for n in ("dfdt", "kf", "kdfdt")}, scalars)
+            ftaps, {n: extras[n] for n in ("dfdt", "kf", "kdfdt")},
+            scalars, energy=energy)
 
         inv_dx2 = [1.0 / d**2 for d in self.dx]
         lap_coefs = _lap_coefs[self.h]
@@ -586,6 +814,7 @@ class FusedPreheatStepper(FusedScalarStepper):
         """Run stages ``s`` and ``s2`` (default ``s+1``) of the
         scalar+GW system as one fused kernel (see
         :meth:`FusedScalarStepper.stage_pair`)."""
+        self._check_pair(s, s + 1 if s2 is None else s2)
         state, k = carry
         outs = self._pair_call(
             {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"],
@@ -611,3 +840,40 @@ class FusedPreheatStepper(FusedScalarStepper):
         new_k = {"f": outs["kf"], "dfdt": outs["kdfdt"],
                  "hij": outs["khij"], "dhijdt": outs["kdhijdt"]}
         return (new_state, new_k)
+
+    def _ensure_energy_call(self):
+        if self._es_call is None:
+            F, H = self.F, self.n_hij
+            st = StreamingStencil(
+                self.local_shape, {"f": F, "hij": H}, self.h,
+                lambda t, e, s: self._preheat_body(t, e, s, energy=True),
+                out_defs={
+                    "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+                    "hij": (H,), "dhijdt": (H,), "khij": (H,),
+                    "kdhijdt": (H,)},
+                extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+                            "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
+                scalar_names=("dt", "a", "hubble", "A", "B"),
+                dtype=self.dtype, bx=self._both_st.bx,
+                by=self._both_st.by, x_halo=(self._px > 1),
+                interpret=self._interpret,
+                sum_defs={"esums": 2 * F + 1})
+            self._es_call = self._make_call(
+                st, windows=("f", "hij"),
+                extra_names=("dfdt", "kf", "kdfdt",
+                             "dhijdt", "khij", "kdhijdt"))
+        return self._es_call
+
+    def _stage_energy(self, s, carry, t, dt, rhs_args):
+        state, k = carry
+        outs = self._es_call(
+            {"f": state["f"], "hij": state["hij"]},
+            self._stage_scalars(s, dt, rhs_args),
+            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"],
+             "dhijdt": state["dhijdt"], "khij": k["hij"],
+             "kdhijdt": k["dhijdt"]})
+        new_state = {"f": outs["f"], "dfdt": outs["dfdt"],
+                     "hij": outs["hij"], "dhijdt": outs["dhijdt"]}
+        new_k = {"f": outs["kf"], "dfdt": outs["kdfdt"],
+                 "hij": outs["khij"], "dhijdt": outs["kdhijdt"]}
+        return ((new_state, new_k), outs["esums"])

@@ -109,8 +109,15 @@ def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
                 "pallas/fused streaming-stencil blocking; use the halo-"
                 "exchange operators (FiniteDifferencer mode='halo') or the "
                 "generic steppers instead")
-        bx = next((b for b in (8, 4, 2, 1) if X % b == 0 and b >= h), 1)
-        return bx, 8
+        # NO blocking fits the budget even at the (bx_min, 8) floor: say so
+        # rather than hand back a config Mosaic's VMEM allocator will
+        # reject at compile time (observed: the 24-window stage-pair
+        # kernel at 512^3 — callers degrade to single-stage kernels)
+        raise ValueError(
+            f"no (bx, by) blocking of lattice {lattice_shape} with "
+            f"{n_comp} window components fits the {budget / 2**20:.0f} MB "
+            "VMEM budget; split the kernel (fewer window components) or "
+            "use the halo-exchange / generic path")
     return best
 
 
@@ -199,11 +206,20 @@ class StreamingStencil:
     :arg scalar_names: names of runtime scalars (handed to the body).
     :arg x_halo: the input x-axis is pre-padded with ``h`` halo rows
         (sharded x); otherwise periodic wrap in-kernel.
+    :arg sum_defs: dict name -> term count: lattice-summed outputs. The
+        body returns a ``(nterms,)`` vector of block sums per name; each
+        grid program writes its partial into a ``(nterms, nbx, 1)``
+        output and :meth:`__call__` finishes the reduction (over
+        programs and y-slabs) outside the kernel — deterministic
+        summation order, no cross-program accumulation. This is how
+        fused RK stages emit energy reductions of their input state for
+        free (the whole state is already in VMEM).
     """
 
     def __init__(self, lattice_shape, win_defs, h, body, out_defs,
                  extra_defs=None, scalar_names=(), dtype=jnp.float32,
-                 bx=None, by=None, x_halo=False, interpret=None):
+                 bx=None, by=None, x_halo=False, interpret=None,
+                 sum_defs=None):
         if h > HY:
             raise ValueError(f"stencil radius {h} exceeds aligned halo {HY}")
         self.lattice_shape = X, Y, Z = tuple(int(s) for s in lattice_shape)
@@ -214,6 +230,7 @@ class StreamingStencil:
         self.h = int(h)
         self.body = body
         self.out_defs = {k: tuple(v) for k, v in dict(out_defs).items()}
+        self.sum_defs = {k: int(v) for k, v in dict(sum_defs or {}).items()}
         self.extra_defs = {k: tuple(v)
                            for k, v in dict(extra_defs or {}).items()}
         self.scalar_names = tuple(scalar_names)
@@ -287,11 +304,18 @@ class StreamingStencil:
         out_shapes = [
             jax.ShapeDtypeStruct(self.out_defs[n] + (X, by, Z), self.dtype)
             for n in self.out_defs]
+        nbx = X // bx
+        for nt in self.sum_defs.values():
+            out_specs.append(pl.BlockSpec(
+                (nt, 1, 1), lambda i: (0, i, 0)))
+            out_shapes.append(
+                jax.ShapeDtypeStruct((nt, nbx, 1), self.dtype))
         return in_specs, out_specs, out_shapes
 
     def _unpack_refs(self, refs):
-        nw, ns, ne, no = (len(self.win_defs), len(self.scalar_names),
-                          len(self.extra_defs), len(self.out_defs))
+        nw, ns, ne = (len(self.win_defs), len(self.scalar_names),
+                      len(self.extra_defs))
+        no = len(self.out_defs) + len(self.sum_defs)
         f_refs = refs[:nw]
         scalar_refs = refs[nw:nw + ns]
         extra_refs = refs[nw + ns:nw + ns + ne]
@@ -308,8 +332,11 @@ class StreamingStencil:
         scalars = {n: r[0] for n, r in zip(self.scalar_names, scalar_refs)}
         extras = {n: r[...] for n, r in zip(self.extra_defs, extra_refs)}
         outs = self.body(taps, extras, scalars)
-        for n, ref in zip(self.out_defs, out_refs):
+        nlat = len(self.out_defs)
+        for n, ref in zip(self.out_defs, out_refs[:nlat]):
             ref[...] = outs[n]
+        for n, ref in zip(self.sum_defs, out_refs[nlat:]):
+            ref[...] = outs[n].reshape(self.sum_defs[n], 1, 1)
 
     def _build(self, j):
         if self.x_halo:
@@ -465,14 +492,19 @@ class StreamingStencil:
                        for n in self.scalar_names]
         extra_args = [extras[n] for n in self.extra_defs]
         out_names = list(self.out_defs)
+        nlat = len(out_names)
         nby = self.lattice_shape[1] // self.by
 
         slabs = [call(*win_args, *scalar_args, *extra_args)
                  for call in self._calls]
-        if nby == 1:
-            return dict(zip(out_names, slabs[0]))
         out = {}
         for k, n in enumerate(out_names):
-            yax = len(self.out_defs[n]) + 1  # y axis of (*lead, X, by, Z)
-            out[n] = jnp.concatenate([s[k] for s in slabs], axis=yax)
+            if nby == 1:
+                out[n] = slabs[0][k]
+            else:
+                yax = len(self.out_defs[n]) + 1  # y of (*lead, X, by, Z)
+                out[n] = jnp.concatenate([s[k] for s in slabs], axis=yax)
+        for k, n in enumerate(self.sum_defs):
+            # finish the reduction over grid programs and y-slabs
+            out[n] = sum(s[nlat + k].sum(axis=(1, 2)) for s in slabs)
         return out

@@ -99,7 +99,7 @@ class Stepper:
     num_stages = NotImplemented
     expected_order = NotImplemented
 
-    def __init__(self, rhs, dt=None, **kwargs):
+    def __init__(self, rhs, dt=None, donate=False, **kwargs):
         if isinstance(rhs, dict) and rhs and not callable(rhs):
             rhs = compile_rhs_dict(rhs)
         elif hasattr(rhs, "rhs_dict"):  # a Sector (or list of Sectors)
@@ -118,8 +118,13 @@ class Stepper:
                 carry = self.stage(s, carry, t, dt, rhs_args)
             return self.extract(carry)
 
-        # one fused XLA computation per (state structure, rhs_args structure)
-        self._jit_step = jax.jit(_step_impl)
+        # one fused XLA computation per (state structure, rhs_args
+        # structure). ``donate=True`` donates the input state buffers to
+        # the step (the caller must not reuse the old state), letting XLA
+        # alias them into the outputs — the difference between fitting
+        # and not fitting large systems in HBM (doc/performance.md).
+        self._jit_step = jax.jit(
+            _step_impl, donate_argnums=(0,) if donate else ())
 
     def _ensure_stage_jits(self):
         """Per-stage executables for the reference-style driver loop

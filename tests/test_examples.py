@@ -85,6 +85,42 @@ def test_scalar_preheating_fused_matches_golden(tmp_path):
         f"constraint {constraint} vs golden {GOLDEN_CONSTRAINT}"
 
 
+def test_scalar_preheating_chunked_frozen_rho_bound(tmp_path):
+    """--chunk-steps drives the hot loop through multi_step (stage pairs
+    across step boundaries) with a frozen-rho per-chunk expansion
+    precompute. Freezing the background's energy feedback for a chunk
+    drops the coupled field+Friedmann integration to first order in the
+    background: measured constraint ~2.7e-2 for chunks of 4 at 32^3 to
+    t=1 (vs 5.6e-8 with per-stage feedback) — the documented accuracy
+    price of the frozen-rho mode (examples/scalar_preheating.py
+    --chunk-steps help). This pins the measured bound so a regression
+    (or a silent physics change) is caught; the energy-coupled chunk
+    driver is the accurate fast path."""
+    stdout = run_example(
+        "scalar_preheating.py", "-grid", "32", "32", "32", "-end-t", "1",
+        "--fused", "--chunk-steps", "4", "--chunk-mode", "frozen",
+        "--outfile", str(tmp_path / "chunked"))
+    line = [ln for ln in stdout.splitlines() if "final constraint" in ln][-1]
+    constraint = float(line.split()[-1])
+    assert constraint < 5e-2, \
+        f"frozen-rho constraint {constraint} far above the measured bound"
+
+
+def test_scalar_preheating_chunked_coupled_matches_golden(tmp_path):
+    """The energy-coupled chunk driver (expansion ODE on device, exact
+    per-stage feedback from in-kernel energy sums) must land in the same
+    golden-constraint band as the per-stage driver loop: identical
+    arithmetic sequence up to reduction summation order."""
+    stdout = run_example(
+        "scalar_preheating.py", "-grid", "32", "32", "32", "-end-t", "1",
+        "--fused", "--chunk-steps", "4",
+        "--outfile", str(tmp_path / "coupled"))
+    line = [ln for ln in stdout.splitlines() if "final constraint" in ln][-1]
+    constraint = float(line.split()[-1])
+    assert abs(constraint - GOLDEN_CONSTRAINT) / GOLDEN_CONSTRAINT < 1e-3, \
+        f"constraint {constraint} vs golden {GOLDEN_CONSTRAINT}"
+
+
 def test_scalar_preheating_spectral_derivs(tmp_path):
     """--halo-shape 0 selects the SpectralCollocator (FFT) derivative path
     end-to-end (reference scalar_preheating.py:92-96)."""

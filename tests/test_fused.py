@@ -13,16 +13,25 @@ from pystella_tpu.ops.fused import FusedPreheatStepper, FusedScalarStepper
 # Small-grid bodies run the Pallas stages in interpret mode (f64,
 # bit-exact vs the generic stepper); compiled Mosaic kernels require
 # Z % 128 == 0 and f32 — the on-device check is bench.py's pallas-parity
-# config (fused vs XLA at 128^3 f32).
-pytestmark = pytest.mark.skipif(
-    jax.default_backend() == "tpu",
-    reason="interpret-mode f64 bodies on sub-lane-tile grids; compiled "
-           "coverage: bench.py pallas-parity at 128^3")
+# config (fused vs XLA at 128^3 f32). Under a TPU-backed session these
+# logic tests still run (ADVICE r3): arrays are placed on the host CPU
+# device and the kernels forced to interpret mode, so the f64 bit-
+# exactness pins hold without a Mosaic lowering.
+_TPU_SESSION = jax.default_backend() == "tpu"
+_XKW = {"interpret": True} if _TPU_SESSION else {}
+
+
+def _arr(x):
+    x = jnp.asarray(x)
+    if _TPU_SESSION:
+        return jax.device_put(x, jax.devices("cpu")[0])
+    return x
 
 
 @pytest.fixture
 def decomp():
-    return ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+    devs = (jax.devices("cpu") if _TPU_SESSION else jax.devices())[:1]
+    return ps.DomainDecomposition((1, 1, 1), devices=devs)
 
 
 def _potential(f):
@@ -31,7 +40,7 @@ def _potential(f):
 
 def _generic_step(decomp, grid_shape, dx, h, state, dt, a, hubble,
                   gravitational_waves=False):
-    derivs = ps.FiniteDifferencer(decomp, h, dx)
+    derivs = ps.FiniteDifferencer(decomp, h, dx, mode="halo")
     sector = ps.ScalarSector(2, potential=_potential)
     sectors = [sector]
     if gravitational_waves:
@@ -62,13 +71,13 @@ def test_pair_stages_match_single_stages(decomp):
     dt = 0.01
     rng = np.random.default_rng(11)
     state = {
-        "f": jnp.asarray(rng.standard_normal((2,) + grid_shape)),
-        "dfdt": jnp.asarray(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "f": _arr(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
     }
     args = {"a": 1.3, "hubble": 0.21}
 
     sector = ps.ScalarSector(2, potential=_potential)
-    kw = dict(dtype=jnp.float64, bx=4, by=8)
+    kw = dict(dtype=jnp.float64, bx=4, by=8, **_XKW)
     paired = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
                                 pair_stages=True, **kw)
     single = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
@@ -93,20 +102,20 @@ def test_multi_step_matches_sequential_steps(decomp):
     dt = 0.01
     rng = np.random.default_rng(13)
     state = {
-        "f": jnp.asarray(rng.standard_normal((2,) + grid_shape)),
-        "dfdt": jnp.asarray(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "f": _arr(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
     }
     args = {"a": 1.3, "hubble": 0.21}
 
     sector = ps.ScalarSector(2, potential=_potential)
     fused = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
-                               dtype=jnp.float64, bx=4, by=8)
+                               dtype=jnp.float64, bx=4, by=8, **_XKW)
     for nsteps in (2, 3):
         ref = dict(state)
         for _ in range(nsteps):
             ref = fused.step(ref, 0.0, dt, args)
         # multi_step donates its input buffers — pass a fresh copy
-        fresh = {k: jnp.array(v) for k, v in state.items()}
+        fresh = {k: _arr(np.asarray(v)) for k, v in state.items()}
         got = fused.multi_step(fresh, nsteps, 0.0, dt, args)
         for name in ("f", "dfdt"):
             err = np.max(np.abs(np.asarray(got[name])
@@ -114,6 +123,222 @@ def test_multi_step_matches_sequential_steps(decomp):
             scale = np.max(np.abs(np.asarray(ref[name])))
             assert err / scale < 1e-14, \
                 f"{name}@{nsteps}: multi_step diverges ({err})"
+
+
+def test_multi_step_rhs_seq_matches_per_stage_loop(decomp):
+    """Per-stage expansion scalars threaded through multi_step(rhs_seq=)
+    must reproduce the driver's per-stage stage() loop bit-for-bit: the
+    pairing only regroups kernels, the (a, hubble) entering each stage
+    update is identical."""
+    grid_shape = (16, 16, 16)
+    h, dx = 2, (0.3, 0.25, 0.2)
+    dt = 0.01
+    rng = np.random.default_rng(17)
+    state = {
+        "f": _arr(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
+    }
+
+    sector = ps.ScalarSector(2, potential=_potential)
+    fused = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                               dtype=jnp.float64, bx=4, by=8, **_XKW)
+    nsteps = 2
+    nflat = nsteps * fused.num_stages
+    a_seq = 1.0 + 0.01 * np.arange(nflat)
+    h_seq = 0.2 - 0.003 * np.arange(nflat)
+
+    # reference: the per-stage driver loop with evolving scalars
+    ref = dict(state)
+    i = 0
+    for _ in range(nsteps):
+        carry = fused.init_carry(ref)
+        for s in range(fused.num_stages):
+            carry = fused.stage(s, carry, 0.0, dt,
+                                {"a": a_seq[i], "hubble": h_seq[i]})
+            i += 1
+        ref = fused.extract(carry)
+
+    fresh = {k: _arr(np.asarray(v)) for k, v in state.items()}
+    got = fused.multi_step(fresh, nsteps, 0.0, dt,
+                           rhs_seq={"a": a_seq, "hubble": h_seq})
+    for name in ("f", "dfdt"):
+        err = np.max(np.abs(np.asarray(got[name]) - np.asarray(ref[name])))
+        scale = np.max(np.abs(np.asarray(ref[name])))
+        assert err / scale < 1e-14, f"{name}: rhs_seq diverges ({err})"
+
+    # malformed sequence lengths are rejected
+    with pytest.raises(ValueError, match="rhs_seq"):
+        fused.multi_step(dict(got), nsteps, 0.0, dt,
+                         rhs_seq={"a": a_seq[:-1]})
+
+
+def test_coupled_multi_step_matches_driver_loop(decomp):
+    """coupled_multi_step integrates the Friedmann ODE on device with
+    per-stage energy feedback from in-kernel reductions; it must
+    reproduce the reference-style driver loop (field stage -> Expansion
+    stage with the entering state's energy) to fp-roundoff — the only
+    difference is the summation order of the energy reduction."""
+    grid_shape = (16, 16, 16)
+    h, dx = 2, (0.3, 0.25, 0.2)
+    dt = 0.01
+    grid_size = float(np.prod(grid_shape))
+    rng = np.random.default_rng(23)
+    state = {
+        "f": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.01 * rng.standard_normal((2,) + grid_shape)),
+    }
+
+    sector = ps.ScalarSector(2, potential=_potential)
+    fused = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                               dtype=jnp.float64, bx=4, by=8, **_XKW)
+    derivs = ps.FiniteDifferencer(decomp, h, dx, mode="halo")
+    reduce_energy = ps.Reduction(decomp, sector, callback=ps.get_rho_and_p,
+                                 grid_size=grid_size)
+
+    def energy_of(st, a):
+        return reduce_energy(f=st["f"], dfdt=st["dfdt"],
+                             lap_f=derivs.lap(st["f"]), a=np.float64(a))
+
+    nsteps = 2
+
+    # reference: the example's per-stage loop (field stage, expansion
+    # stage on the entering energy, re-reduce)
+    ref = dict(state)
+    energy = energy_of(ref, 1.0)
+    expand_ref = ps.Expansion(energy["total"], ps.LowStorageRK54)
+    for _ in range(nsteps):
+        carry = fused.init_carry(ref)
+        for s in range(fused.num_stages):
+            carry = fused.stage(s, carry, 0.0, dt,
+                                {"a": np.float64(expand_ref.a),
+                                 "hubble": np.float64(expand_ref.hubble)})
+            expand_ref.step(s, energy["total"], energy["pressure"], dt)
+            energy = energy_of(fused.current(carry), expand_ref.a)
+        ref = fused.extract(carry)
+
+    # coupled chunk
+    energy0 = energy_of(state, 1.0)
+    expand = ps.Expansion(energy0["total"], ps.LowStorageRK54)
+    fresh = {k: _arr(np.asarray(v)) for k, v in state.items()}
+    got = fused.coupled_multi_step(fresh, nsteps, expand, 0.0, dt,
+                                   grid_size=grid_size)
+
+    for name in ("f", "dfdt"):
+        err = np.max(np.abs(np.asarray(got[name]) - np.asarray(ref[name])))
+        scale = np.max(np.abs(np.asarray(ref[name])))
+        assert err / scale < 1e-12, f"{name}: coupled diverges ({err})"
+    assert abs(expand.a - expand_ref.a) / expand_ref.a < 1e-12
+    assert abs(expand.adot - expand_ref.adot) / expand_ref.adot < 1e-12
+
+
+def test_coupled_multi_step_gw(decomp):
+    """The scalar+GW coupled chunk matches the per-stage driver loop
+    (expansion couples to the scalar-sector energy only)."""
+    grid_shape = (16, 16, 16)
+    h, dx = 2, 0.3
+    dt = 0.01
+    grid_size = float(np.prod(grid_shape))
+    rng = np.random.default_rng(29)
+    state = {
+        "f": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.01 * rng.standard_normal((2,) + grid_shape)),
+        "hij": _arr(1e-3 * rng.standard_normal((6,) + grid_shape)),
+        "dhijdt": _arr(1e-4 * rng.standard_normal((6,) + grid_shape)),
+    }
+
+    sector = ps.ScalarSector(2, potential=_potential)
+    gw = ps.TensorPerturbationSector([sector])
+    fused = FusedPreheatStepper(sector, gw, decomp, grid_shape, dx, h,
+                                dtype=jnp.float64, bx=4, by=8, **_XKW)
+    derivs = ps.FiniteDifferencer(decomp, h, (dx,) * 3, mode="halo")
+    reduce_energy = ps.Reduction(decomp, sector, callback=ps.get_rho_and_p,
+                                 grid_size=grid_size)
+
+    def energy_of(st, a):
+        return reduce_energy(f=st["f"], dfdt=st["dfdt"],
+                             lap_f=derivs.lap(st["f"]), a=np.float64(a))
+
+    nsteps = 2
+    ref = dict(state)
+    energy = energy_of(ref, 1.0)
+    expand_ref = ps.Expansion(energy["total"], ps.LowStorageRK54)
+    for _ in range(nsteps):
+        carry = fused.init_carry(ref)
+        for s in range(fused.num_stages):
+            carry = fused.stage(s, carry, 0.0, dt,
+                                {"a": np.float64(expand_ref.a),
+                                 "hubble": np.float64(expand_ref.hubble)})
+            expand_ref.step(s, energy["total"], energy["pressure"], dt)
+            energy = energy_of(fused.current(carry), expand_ref.a)
+        ref = fused.extract(carry)
+
+    energy0 = energy_of(state, 1.0)
+    expand = ps.Expansion(energy0["total"], ps.LowStorageRK54)
+    fresh = {k: _arr(np.asarray(v)) for k, v in state.items()}
+    got = fused.coupled_multi_step(fresh, nsteps, expand, 0.0, dt,
+                                   grid_size=grid_size)
+
+    for name in ("f", "dfdt", "hij", "dhijdt"):
+        err = np.max(np.abs(np.asarray(got[name]) - np.asarray(ref[name])))
+        scale = max(np.max(np.abs(np.asarray(ref[name]))), 1e-30)
+        assert err / scale < 1e-12, f"{name}: coupled diverges ({err})"
+    assert abs(expand.a - expand_ref.a) / expand_ref.a < 1e-12
+
+
+def test_coupled_multi_step_sharded_x_matches_single():
+    """Energy-coupled chunks on an x-sharded mesh (per-shard esums
+    psum'ed inside the shard_map) match the single-device result."""
+    if len(jax.devices()) < 2 or _TPU_SESSION:
+        pytest.skip("needs 2 CPU devices")
+    grid_shape = (16, 16, 16)
+    h, dx, dt = 2, 0.3, 0.01
+    grid_size = float(np.prod(grid_shape))
+    rng = np.random.default_rng(31)
+    state_h = {
+        "f": 0.1 * rng.standard_normal((2,) + grid_shape),
+        "dfdt": 0.01 * rng.standard_normal((2,) + grid_shape),
+    }
+    sector = ps.ScalarSector(2, potential=_potential)
+
+    results = []
+    for px in (1, 2):
+        dp = ps.DomainDecomposition((px, 1, 1), devices=jax.devices()[:px])
+        fp = FusedScalarStepper(sector, dp, grid_shape, dx, h,
+                                dtype=jnp.float64, bx=4, by=8)
+        expand = ps.Expansion(1e-3, ps.LowStorageRK54)
+        st = {k: dp.shard(jnp.asarray(v)) for k, v in state_h.items()}
+        got = fp.coupled_multi_step(st, 2, expand, 0.0, dt,
+                                    grid_size=grid_size)
+        results.append((got, expand.a, expand.adot))
+
+    (ref, a1, adot1), (got, a2, adot2) = results
+    for name in ("f", "dfdt"):
+        assert np.allclose(np.asarray(got[name]), np.asarray(ref[name]),
+                           rtol=1e-12, atol=1e-13), name
+    assert abs(a2 - a1) / a1 < 1e-13
+    assert abs(adot2 - adot1) / abs(adot1) < 1e-13
+
+
+def test_stage_pair_guards(decomp):
+    """stage_pair raises clearly when pairing is disabled, and rejects a
+    wrapped pairing whose tableau carry scale is nonzero (ADVICE r3)."""
+    grid_shape = (16, 16, 16)
+    sector = ps.ScalarSector(1, potential=lambda f: 0.5 * f[0] ** 2)
+    single = FusedScalarStepper(sector, decomp, grid_shape, 0.3, 2,
+                                pair_stages=False, dtype=jnp.float64,
+                                bx=4, by=8, **_XKW)
+    state = {"f": _arr(np.zeros((1,) + grid_shape)),
+             "dfdt": _arr(np.zeros((1,) + grid_shape))}
+    carry = single.init_carry(state)
+    with pytest.raises(RuntimeError, match="stage-pair"):
+        single.stage_pair(0, carry, 0.0, 0.01, {})
+
+    paired = FusedScalarStepper(sector, decomp, grid_shape, 0.3, 2,
+                                dtype=jnp.float64, bx=4, by=8, **_XKW)
+    # RK54 has A[1] != 0: pairing stage 4 with next-step stage 1 would
+    # need the skipped k-carry reset to matter -> must be rejected
+    with pytest.raises(ValueError, match="A\\[1\\]"):
+        paired.stage_pair(4, paired.init_carry(state), 0.0, 0.01, {}, s2=1)
 
 
 def test_preheat_pair_stages_match_single_stages(decomp):
@@ -124,17 +349,17 @@ def test_preheat_pair_stages_match_single_stages(decomp):
     dt = 0.01
     rng = np.random.default_rng(12)
     state = {
-        "f": jnp.asarray(rng.standard_normal((2,) + grid_shape)),
-        "dfdt": jnp.asarray(0.1 * rng.standard_normal((2,) + grid_shape)),
-        "hij": jnp.asarray(1e-3 * rng.standard_normal((6,) + grid_shape)),
-        "dhijdt": jnp.asarray(
+        "f": _arr(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "hij": _arr(1e-3 * rng.standard_normal((6,) + grid_shape)),
+        "dhijdt": _arr(
             1e-4 * rng.standard_normal((6,) + grid_shape)),
     }
     args = {"a": 1.3, "hubble": 0.21}
 
     sector = ps.ScalarSector(2, potential=_potential)
     gw = ps.TensorPerturbationSector([sector])
-    kw = dict(dtype=jnp.float64, bx=4, by=8)
+    kw = dict(dtype=jnp.float64, bx=4, by=8, **_XKW)
     paired = FusedPreheatStepper(sector, gw, decomp, grid_shape, dx, h,
                                  pair_stages=True, **kw)
     single = FusedPreheatStepper(sector, gw, decomp, grid_shape, dx, h,
@@ -149,14 +374,49 @@ def test_preheat_pair_stages_match_single_stages(decomp):
         assert err / scale < 1e-14, f"{name}: pair/single diverge ({err})"
 
 
+def test_preheat_pair_degrades_at_production_size(decomp):
+    """At 512**3 the 24-window-component preheat pair kernel has no
+    VMEM-feasible blocking (ADVICE r3, medium): construction must warn
+    and degrade to single-stage kernels instead of handing Mosaic an
+    over-budget config, while the scalar-only pair (6 components) stays
+    paired at the same size."""
+    import warnings
+    from pystella_tpu.ops.pallas_stencil import choose_blocks
+
+    with pytest.raises(ValueError, match="VMEM budget"):
+        choose_blocks(24, (512, 512, 512), 2, 4, n_extra=8, n_out=32)
+
+    sector = ps.ScalarSector(2, potential=_potential)
+    gw = ps.TensorPerturbationSector([sector])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        stepper = FusedPreheatStepper(sector, gw, decomp, (512, 512, 512),
+                                      0.01, 2, dtype=jnp.float32, **_XKW)
+    assert stepper._pair_call is None and not stepper._pair_stages
+    assert any("stage-pair fusion disabled" in str(w.message)
+               for w in caught)
+    # the single-stage kernel remains available at this size
+    assert stepper._both_st.bx >= 2
+
+    scalar = FusedScalarStepper(sector, decomp, (512, 512, 512), 0.01, 2,
+                                dtype=jnp.float32, **_XKW)
+    assert scalar._pair_call is not None
+
+    # explicitly pinned pair blocking is honored verbatim (no degrade)
+    pinned = FusedPreheatStepper(sector, gw, decomp, (512, 512, 512),
+                                 0.01, 2, dtype=jnp.float32,
+                                 pair_bx=2, pair_by=8, **_XKW)
+    assert pinned._pair_call is not None
+
+
 def test_fused_scalar_matches_generic(decomp):
     grid_shape = (16, 16, 16)
     h, dx = 2, (0.3, 0.25, 0.2)
     dt = 0.01
     rng = np.random.default_rng(5)
     state = {
-        "f": jnp.asarray(rng.standard_normal((2,) + grid_shape)),
-        "dfdt": jnp.asarray(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "f": _arr(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
     }
     a, hubble = 1.3, 0.21
 
@@ -164,7 +424,7 @@ def test_fused_scalar_matches_generic(decomp):
 
     sector = ps.ScalarSector(2, potential=_potential)
     fused = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
-                               dtype=jnp.float64, bx=4, by=8)
+                               dtype=jnp.float64, bx=4, by=8, **_XKW)
     got = fused.step(state, 0.0, dt, {"a": a, "hubble": hubble})
 
     for name in ("f", "dfdt"):
@@ -179,12 +439,12 @@ def test_fused_scalar_per_stage_interface(decomp):
     h, dx, dt = 1, 0.3, 0.02
     rng = np.random.default_rng(6)
     state = {
-        "f": jnp.asarray(rng.standard_normal((1,) + grid_shape)),
-        "dfdt": jnp.asarray(rng.standard_normal((1,) + grid_shape)),
+        "f": _arr(rng.standard_normal((1,) + grid_shape)),
+        "dfdt": _arr(rng.standard_normal((1,) + grid_shape)),
     }
     sector = ps.ScalarSector(1, potential=lambda f: 0.5 * f[0] ** 2)
     fused = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
-                               dtype=jnp.float64, bx=4, by=8)
+                               dtype=jnp.float64, bx=4, by=8, **_XKW)
 
     whole = fused.step(state, 0.0, dt, {"a": 1.0, "hubble": 0.0})
     carry = state
@@ -201,10 +461,10 @@ def test_fused_preheat_matches_generic(decomp):
     dt = 0.01
     rng = np.random.default_rng(7)
     state = {
-        "f": jnp.asarray(rng.standard_normal((2,) + grid_shape)),
-        "dfdt": jnp.asarray(0.1 * rng.standard_normal((2,) + grid_shape)),
-        "hij": jnp.asarray(1e-3 * rng.standard_normal((6,) + grid_shape)),
-        "dhijdt": jnp.asarray(1e-4 * rng.standard_normal((6,) + grid_shape)),
+        "f": _arr(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "hij": _arr(1e-3 * rng.standard_normal((6,) + grid_shape)),
+        "dhijdt": _arr(1e-4 * rng.standard_normal((6,) + grid_shape)),
     }
     a, hubble = 1.1, 0.13
 
@@ -214,7 +474,7 @@ def test_fused_preheat_matches_generic(decomp):
     sector = ps.ScalarSector(2, potential=_potential)
     gw = ps.TensorPerturbationSector([sector])
     fused = FusedPreheatStepper(sector, gw, decomp, grid_shape, dx, h,
-                                dtype=jnp.float64, bx=4, by=8)
+                                dtype=jnp.float64, bx=4, by=8, **_XKW)
     got = fused.step(state, 0.0, dt, {"a": a, "hubble": hubble})
 
     for name in ("f", "dfdt", "hij", "dhijdt"):
@@ -239,13 +499,13 @@ def test_fused_scalar_sharded_x_matches_single(px):
 
     d1 = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
     f1 = FusedScalarStepper(sector, d1, grid_shape, dx, h,
-                            dtype=jnp.float64, bx=4, by=8)
+                            dtype=jnp.float64, bx=4, by=8, **_XKW)
     ref = f1.step({k: jnp.asarray(v) for k, v in state_h.items()},
                   0.0, dt, {"a": 1.2, "hubble": 0.3})
 
     dp = ps.DomainDecomposition((px, 1, 1), devices=jax.devices()[:px])
     fp = FusedScalarStepper(sector, dp, grid_shape, dx, h,
-                            dtype=jnp.float64, bx=4, by=8)
+                            dtype=jnp.float64, bx=4, by=8, **_XKW)
     got = fp.step({k: dp.shard(v) for k, v in state_h.items()},
                   0.0, dt, {"a": 1.2, "hubble": 0.3})
 
@@ -271,13 +531,13 @@ def test_fused_preheat_sharded_x_matches_single():
 
     d1 = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
     f1 = FusedPreheatStepper(sector, gw, d1, grid_shape, dx, h,
-                             dtype=jnp.float64, bx=4, by=8)
+                             dtype=jnp.float64, bx=4, by=8, **_XKW)
     ref = f1.step({k: jnp.asarray(v) for k, v in state_h.items()},
                   0.0, dt, {"a": 1.1, "hubble": 0.2})
 
     dp = ps.DomainDecomposition((2, 1, 1), devices=jax.devices()[:2])
     fp = FusedPreheatStepper(sector, gw, dp, grid_shape, dx, h,
-                             dtype=jnp.float64, bx=4, by=8)
+                             dtype=jnp.float64, bx=4, by=8, **_XKW)
     got = fp.step({k: dp.shard(v) for k, v in state_h.items()},
                   0.0, dt, {"a": 1.1, "hubble": 0.2})
 
