@@ -56,12 +56,15 @@ class FusedScalarStepper(_step.Stepper):
 
     :arg sector: a :class:`~pystella_tpu.ScalarSector`.
     :arg decomp: :class:`~pystella_tpu.DomainDecomposition`; the lattice
-        may be sharded along x (``proc_shape (px, 1, 1)``) — each device
-        pads its x-block with ``lax.ppermute`` halos and runs the fused
-        kernel on its local block inside ``shard_map``. For y/z-sharded
-        meshes use the generic steppers.
-    :arg grid_shape: the *global* lattice shape (divided over the mesh's
-        x axis when sharded).
+        may be sharded along x and/or y (``proc_shape (px, py, 1)``) —
+        each device pads its block with ``lax.ppermute`` halos and runs
+        the fused kernel on its local block inside ``shard_map`` (the
+        sharded-y window pad is the 8-aligned ``HY``, see
+        :class:`~pystella_tpu.ops.pallas_stencil.StreamingStencil`).
+        The z axis (the VMEM lane dimension) stays whole per device; use
+        the generic steppers for z-sharded meshes.
+    :arg grid_shape: the *global* lattice shape (divided over the mesh
+        when sharded).
     :arg dx: lattice spacing (scalar or 3-tuple).
     :arg halo_shape: stencil radius ``h``.
     :arg tableau: a :class:`~pystella_tpu.LowStorageRKStepper` subclass
@@ -91,12 +94,14 @@ class FusedScalarStepper(_step.Stepper):
         self.dt = dt
         self.sector = sector
         self.decomp = decomp
-        if decomp.proc_shape[1] != 1 or decomp.proc_shape[2] != 1:
+        if decomp.proc_shape[2] != 1:
             raise NotImplementedError(
-                "fused steppers support sharding only along x "
-                "(proc_shape (px, 1, 1)); use the generic LowStorageRK "
-                "steppers with FiniteDifferencer for y/z-sharded meshes")
+                "fused steppers support x/y sharding (proc_shape "
+                "(px, py, 1)); the z axis is the VMEM lane dimension "
+                "(kept whole per device) — use the generic LowStorageRK "
+                "steppers with FiniteDifferencer for z-sharded meshes")
         self._px = decomp.proc_shape[0]
+        self._py = decomp.proc_shape[1]
         self.grid_shape = tuple(grid_shape)
         if np.isscalar(dx):
             dx = (dx,) * 3
@@ -129,6 +134,13 @@ class FusedScalarStepper(_step.Stepper):
         self._jit_coupled = {}  # (nsteps, grid_size, mpl) -> jitted
         self._es_call = None  # lazily built energy-emitting stage kernel
 
+    @property
+    def _halo_kw(self):
+        """Shared StreamingStencil kwargs: pre-padded windows per sharded
+        axis, and the interpret-mode override."""
+        return {"x_halo": self._px > 1, "y_halo": self._py > 1,
+                "interpret": self._interpret}
+
     def _try_pair_stencil(self, make):
         """Build the stage-pair kernel, degrading to single-stage kernels
         when no blocking of the (much wider) pair window fits the VMEM
@@ -159,8 +171,7 @@ class FusedScalarStepper(_step.Stepper):
                 "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
             extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
             scalar_names=("dt", "a", "hubble", "A", "B"),
-            dtype=self.dtype, bx=bx, by=by, x_halo=(self._px > 1),
-            interpret=self._interpret)
+            dtype=self.dtype, bx=bx, by=by, **self._halo_kw)
         self._scalar_call = self._make_call(
             self._scalar_st, windows=("f",),
             extra_names=("dfdt", "kf", "kdfdt"))
@@ -188,17 +199,17 @@ class FusedScalarStepper(_step.Stepper):
                 scalar_names=("dt", "a1", "hubble1", "A1", "B1",
                               "a2", "hubble2", "A2", "B2"),
                 dtype=self.dtype, bx=self._pair_bx, by=self._pair_by,
-                x_halo=(self._px > 1), interpret=self._interpret))
+                **self._halo_kw))
             if self._pair_st is not None:
                 self._pair_call = self._make_call(
                     self._pair_st,
                     windows=("f", "dfdt", "kf"), extra_names=("kdfdt",))
 
     def _make_call(self, st, windows, extra_names):
-        """Wrap a StreamingStencil in the sharded-x ``shard_map`` (padding
-        the windowed inputs with ``ppermute`` halos) or call it directly on
-        an unsharded lattice."""
-        if self._px == 1:
+        """Wrap a StreamingStencil in a ``shard_map`` over the sharded
+        mesh axes (padding the windowed inputs with ``ppermute`` halos)
+        or call it directly on an unsharded lattice."""
+        if self._px == 1 and self._py == 1:
             def call(win_arrays, scalars, extras):
                 arg = (win_arrays[windows[0]] if len(windows) == 1
                        else win_arrays)
@@ -206,15 +217,19 @@ class FusedScalarStepper(_step.Stepper):
             return call
 
         import jax
+        from pystella_tpu.ops.pallas_stencil import HY
         decomp = self.decomp
-        h = self.h
+        # x pads with the stencil radius; y pads with the 8-aligned HY
+        # window width (Mosaic-clean sublane offsets, see StreamingStencil)
+        halo = (self.h if self._px > 1 else 0,
+                HY if self._py > 1 else 0, 0)
         out_names = list(st.out_defs) + list(st.sum_defs)
         scalar_names = st.scalar_names
         from jax.sharding import PartitionSpec as P
 
         def body(*flat):
             nw = len(windows)
-            wins = {n: decomp.pad_with_halos(a, (h, 0, 0))
+            wins = {n: decomp.pad_with_halos(a, halo)
                     for n, a in zip(windows, flat[:nw])}
             ns = len(scalar_names)
             scalars = dict(zip(scalar_names, flat[nw:nw + ns]))
@@ -402,8 +417,7 @@ class FusedScalarStepper(_step.Stepper):
                 extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
                 scalar_names=("dt", "a", "hubble", "A", "B"),
                 dtype=self.dtype, bx=self._scalar_st.bx,
-                by=self._scalar_st.by, x_halo=(self._px > 1),
-                interpret=self._interpret,
+                by=self._scalar_st.by, **self._halo_kw,
                 sum_defs={"esums": 2 * F + 1})
             self._es_call = self._make_call(
                 st, windows=("f",), extra_names=("dfdt", "kf", "kdfdt"))
@@ -693,8 +707,7 @@ class FusedPreheatStepper(FusedScalarStepper):
             extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,),
                         "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
             scalar_names=("dt", "a", "hubble", "A", "B"),
-            dtype=self.dtype, bx=bx, by=by, x_halo=(self._px > 1),
-            interpret=self._interpret)
+            dtype=self.dtype, bx=bx, by=by, **self._halo_kw)
         self._both_call = self._make_call(
             self._both_st, windows=("f", "hij"),
             extra_names=("dfdt", "kf", "kdfdt",
@@ -717,7 +730,7 @@ class FusedPreheatStepper(FusedScalarStepper):
                 scalar_names=("dt", "a1", "hubble1", "A1", "B1",
                               "a2", "hubble2", "A2", "B2"),
                 dtype=self.dtype, bx=self._pair_bx, by=self._pair_by,
-                x_halo=(self._px > 1), interpret=self._interpret))
+                **self._halo_kw))
             if self._pair_st is not None:
                 self._pair_call = self._make_call(
                     self._pair_st,
@@ -855,8 +868,7 @@ class FusedPreheatStepper(FusedScalarStepper):
                             "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
                 scalar_names=("dt", "a", "hubble", "A", "B"),
                 dtype=self.dtype, bx=self._both_st.bx,
-                by=self._both_st.by, x_halo=(self._px > 1),
-                interpret=self._interpret,
+                by=self._both_st.by, **self._halo_kw,
                 sum_defs={"esums": 2 * F + 1})
             self._es_call = self._make_call(
                 st, windows=("f", "hij"),
