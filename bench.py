@@ -146,14 +146,16 @@ def sync(tree):
 def _resolve_fused(fused, grid_shape=None):
     """"auto" -> fused Pallas stages on TPU only; on CPU they would run
     in interpret mode (~100x slower than the XLA path) and misrepresent
-    the framework. Compiled kernels also require a lane-aligned z axis
-    (``Z % 128 == 0`` — pallas_stencil.LANE); smaller grids take the XLA
-    halo path."""
+    the framework. Streaming kernels require a lane-aligned z axis
+    (``Z % 128 == 0`` — pallas_stencil.LANE); below that the fused
+    steppers auto-select the whole-lattice-resident kernel tier, which
+    fits the scalar system up to ~64^3 f32 (ResidentStencil budget)."""
     if fused == "auto":
         import jax
         from pystella_tpu.ops.pallas_stencil import LANE
-        lane_ok = grid_shape is None or grid_shape[-1] % LANE == 0
-        return jax.default_backend() == "tpu" and lane_ok
+        ok = grid_shape is None or (grid_shape[-1] % LANE == 0
+                                    or max(grid_shape) <= 64)
+        return jax.default_backend() == "tpu" and ok
     return fused
 
 
@@ -178,11 +180,20 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
     sector = ps.ScalarSector(2, potential=potential)
 
     if fused:
-        # fully-fused Pallas stages: stencil + KG rhs + RK update in one
-        # pass over HBM per stage
-        stepper = ps.FusedScalarStepper(sector, decomp, grid_shape,
-                                        lattice.dx, halo_shape, dtype=dtype)
-    else:
+        try:
+            # fully-fused Pallas stages: stencil + KG rhs + RK update in
+            # one pass over HBM per stage
+            stepper = ps.FusedScalarStepper(
+                sector, decomp, grid_shape, lattice.dx, halo_shape,
+                dtype=dtype)
+        except ValueError as e:
+            # no streaming blocking AND over the resident VMEM budget
+            # (the _resolve_fused gate is a heuristic; construction is
+            # the real feasibility check) -> generic XLA path
+            hb(f"fused stepper infeasible for {grid_shape} ({e}); "
+               "using the generic path")
+            fused = False
+    if not fused:
         derivs = ps.FiniteDifferencer(decomp, halo_shape, lattice.dx)
         sector_rhs = ps.compile_rhs_dict(sector.rhs_dict)
 

@@ -153,28 +153,68 @@ class DFT:
         self.rdtype = get_real_dtype_with_matching_prec(self.dtype)
         self.cdtype = get_complex_dtype_with_matching_prec(self.dtype)
 
-        # pencil scheme feasibility: the x and y axes are resharded over the
-        # *combined* mesh axes between per-axis FFTs, so both must divide by
-        # the total device count (documented design decision; uneven shards
-        # fall back to a replicate-transform-reshard path). Unlike the
-        # reference (z decomposition is NotImplementedError, decomp.py:129-130)
-        # z-sharded meshes are supported: the transform starts by resharding
-        # to an x-only pencil so the z axis is local, and k-space arrays keep
-        # the (half-spectrum) z axis unsharded.
+        # Pencil-scheme selection (three tiers, VERDICT r3 #7):
+        #
+        # - "pencil": the x (then y) axis is resharded over the COMBINED
+        #   mesh axes between per-axis FFTs — minimal memory; needs
+        #   grid x and y divisible by the total device count.
+        # - "partial": each FFT stage shards its long axis by ONE mesh
+        #   axis only (x by px during the y-FFT, y by py during the
+        #   x-FFT; the other mesh axis replicates). Needs only the
+        #   per-axis divisibility the position-space home already
+        #   guarantees; transient memory is max(px, py) x the home
+        #   block instead of ndev x. (A classic 2-D pencil would shard
+        #   the half-spectrum z axis instead, but Nz/2+1 is odd and jax
+        #   shardings require even divisibility.)
+        # - "replicate": transforms replicate the array on every device
+        #   and run redundantly. Correct but an OOM/bandwidth cliff at
+        #   production sizes, so above ``replicate_limit`` bytes
+        #   (default 1 GiB) construction RAISES instead (pass
+        #   ``allow_replicate=True`` to override).
+        #
+        # Unlike the reference (z decomposition is NotImplementedError,
+        # decomp.py:129-130) z-sharded meshes are supported: the
+        # transform reshards to an x-only pencil first so z is local,
+        # and k-space arrays keep the (half-spectrum) z axis unsharded.
         nproc = int(np.prod(decomp.proc_shape))
-        self._pencil_ok = (self.grid_shape[0] % nproc == 0
-                           and self.grid_shape[1] % nproc == 0)
+        px, py, pz = decomp.proc_shape
         self._nproc = nproc
-        self._z_sharded = decomp.proc_shape[2] > 1
-        if nproc > 1 and not self._pencil_ok:
-            logger.warning(
-                "DFT %s on %d devices: grid x/y axes do not divide the "
-                "device count, so the pencil scheme is infeasible — "
-                "transforms will REPLICATE the array on every device and "
-                "run redundantly (correct, but an OOM/bandwidth cliff at "
-                "production sizes). Choose grid/mesh shapes with "
-                "grid_shape[0] %% ndev == 0 and grid_shape[1] %% ndev == 0.",
-                self.grid_shape, nproc)
+        self._z_sharded = pz > 1
+        if (self.grid_shape[0] % nproc == 0
+                and self.grid_shape[1] % nproc == 0):
+            self._scheme = "pencil"
+        elif (pz == 1 and self.grid_shape[0] % px == 0
+                and self.grid_shape[1] % py == 0):
+            self._scheme = "partial"
+            logger.info(
+                "DFT %s on %d devices: using the partial-replication "
+                "pencil scheme (per-stage long axis sharded by one mesh "
+                "axis; transient memory ~%d x the home block)",
+                self.grid_shape, nproc, max(px, py))
+        else:
+            self._scheme = "replicate"
+            nbytes = (int(np.prod(self.grid_shape))
+                      * np.dtype(self.cdtype).itemsize)
+            limit = float(kwargs.pop("replicate_limit", 2**30))
+            if nproc > 1 and not kwargs.pop("allow_replicate", False) \
+                    and nbytes > limit:
+                raise ValueError(
+                    f"DFT {self.grid_shape} on {nproc} devices: no "
+                    "distributed scheme is feasible (grid axes do not "
+                    f"divide the mesh axes) and the k-space array "
+                    f"(~{nbytes / 2**30:.1f} GiB) exceeds the "
+                    "replicate-fallback limit — every device would hold "
+                    "and transform the FULL array. Choose divisible "
+                    "grid/mesh shapes, or pass allow_replicate=True / "
+                    "a larger replicate_limit to accept the cost")
+            if nproc > 1:
+                logger.warning(
+                    "DFT %s on %d devices: grid axes do not divide the "
+                    "mesh axes — transforms will REPLICATE the array on "
+                    "every device and run redundantly (correct, but "
+                    "wasteful). Choose divisible grid/mesh shapes.",
+                    self.grid_shape, nproc)
+        self._pencil_ok = self._scheme != "replicate"
 
         k = [fftfreq(n).astype(self.rdtype) for n in self.grid_shape]
         if self.is_real:
@@ -228,7 +268,15 @@ class DFT:
     def _specs(self, outer):
         from jax.sharding import NamedSharding, PartitionSpec as P
         names = self._names()
-        mixed = tuple(n for n in names if n is not None)
+        if self._scheme == "partial":
+            # per-stage long axis sharded by its OWN mesh axis only (the
+            # other mesh axis replicates) — feasible whenever the home
+            # sharding is, since that already requires X % px == 0 and
+            # Y % py == 0 (the combined-axes pencil needs X % ndev)
+            x_ent, y_ent = names[0], names[1]
+        else:
+            mixed = tuple(n for n in names if n is not None)
+            x_ent = y_ent = mixed or None
         o = (None,) * outer
         # concrete NamedShardings (mesh embedded): ``reshard`` then needs
         # no ambient mesh context, so transforms trace identically in
@@ -236,8 +284,8 @@ class DFT:
         ns = (lambda *ent: NamedSharding(self.decomp.mesh, P(*o, *ent)))
         return (ns(names[0], names[1], names[2]),   # position-space home
                 ns(names[0], names[1], None),       # k-space home, z local
-                ns(mixed or None, None, None),      # x sharded, y/z local
-                ns(None, mixed or None, None))      # y sharded, x/z local
+                ns(x_ent, None, None),              # x sharded, y/z local
+                ns(None, y_ent, None))              # y sharded, x/z local
 
     def _mid_spec(self, outer):
         """Staging layout for z-sharded meshes: z local, z's mesh devices

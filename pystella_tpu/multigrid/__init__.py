@@ -196,14 +196,17 @@ class FullApproximationScheme:
 
     def smooth(self, levels, i, nu, unknowns, rhos, aux, decomp=None):
         """Relax level ``i`` for ``nu`` sweeps, recording errors before and
-        after (reference multigrid/__init__.py:285-302)."""
+        after (reference multigrid/__init__.py:285-302). The error record
+        syncs to host per smooth — deferring the device scalars to the
+        cycle end was measured to abort XLA's CPU runtime on 3-axis
+        meshes, so the norms are materialized eagerly."""
         solver = self.solver
-        errs1 = solver.get_error(levels[i], unknowns[i], rhos[i], aux[i],
-                                 decomp)
+        errs1 = solver.get_error(levels[i], unknowns[i], rhos[i],
+                                 aux[i], decomp)
         unknowns[i] = solver.smooth(levels[i], unknowns[i], rhos[i],
                                     aux[i], nu, decomp)
-        errs2 = solver.get_error(levels[i], unknowns[i], rhos[i], aux[i],
-                                 decomp)
+        errs2 = solver.get_error(levels[i], unknowns[i], rhos[i],
+                                 aux[i], decomp)
         return [(i, errs1), (i, errs2)]
 
     # -- entry point --------------------------------------------------------

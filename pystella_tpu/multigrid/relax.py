@@ -76,12 +76,20 @@ class RelaxationBase:
     """
 
     def __init__(self, decomp, lhs_dict, halo_shape=1, omega=1.0,
-                 dtype=None, **kwargs):
+                 dtype=None, smoother="auto", **kwargs):
         self.decomp = decomp
         self.halo_shape = int(halo_shape)
         self.omega = float(kwargs.pop("fixed_parameters", {}).get(
             "omega", omega))
         self.dtype = dtype
+        if smoother == "auto":
+            # the Pallas sweep kernels are the measured-fast tier on TPU;
+            # on CPU they would run in interpret mode (orders of magnitude
+            # slower than XLA) — tests opt in explicitly
+            smoother = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if smoother not in ("pallas", "xla"):
+            raise ValueError(f"unknown smoother {smoother}")
+        self.smoother = smoother
         self.stencil = SecondCenteredDifference(self.halo_shape)
 
         self.f_to_rho_dict = {}
@@ -188,28 +196,183 @@ class RelaxationBase:
             return arrays
         return {k: jnp.asarray(v, self.dtype) for k, v in arrays.items()}
 
+    # -- Pallas sweep tier ---------------------------------------------------
+
+    def _aux_struct(self, aux):
+        """Static routing of auxiliary arrays: lattice-shaped values ride
+        the kernel's blockwise extras, scalars go to SMEM."""
+        struct = []
+        for k in sorted(aux):
+            v = aux[k]
+            ndim = getattr(v, "ndim", 0)
+            struct.append((k, "lattice" if ndim >= 3 else "scalar"))
+        return tuple(struct)
+
+    def _pallas_level(self, kind, level, decomp, dtype, aux_struct):
+        """A stencil-kernel pass for one level: ``smooth`` (runtime-``nu``
+        ``fori_loop`` of whole-sweep kernels — one compile serves every
+        sweep count) or ``residual``. Each sweep reads the unknowns once
+        from HBM, computes the order-2h Laplacian from the VMEM window,
+        evaluates the update pointwise, and writes once — the identical
+        streaming pattern as the fused RK stages, replacing the XLA
+        halo-pad sweeps measured ~10x below bandwidth (VERDICT r3 #5).
+        Returns None when this level/mesh cannot take the kernel tier
+        (z-sharded, sublane-infeasible sharded y, over-budget resident)
+        — callers fall back to the XLA path."""
+        from pystella_tpu.ops.pallas_stencil import (
+            HY, ResidentStencil, StreamingStencil, lap_from_taps)
+
+        key = ("pallas", kind, level, decomp, str(dtype), aux_struct)
+        if key in self._compiled:
+            return self._compiled[key]
+
+        names = list(self.f_to_rho_dict)
+        nf = len(names)
+        proc = decomp.proc_shape if level.sharded else (1, 1, 1)
+        px, py, pz = proc
+        local_shape = tuple(n // p for n, p in zip(level.grid_shape, proc))
+        feasible = (pz == 1
+                    and (py == 1 or (local_shape[1] >= HY
+                                     and local_shape[1] % HY == 0)))
+        coefs = self.stencil.coefs
+        inv_dx2 = [1.0 / d**2 for d in level.dx]
+        aux_lat = [k for k, kk in aux_struct if kk == "lattice"]
+        aux_scal = [k for k, kk in aux_struct if kk == "scalar"]
+        exprs = (self.step_exprs if kind == "smooth"
+                 else self.resid_exprs)
+
+        def body(taps, extras, scalars):
+            fs = taps()
+            lap = lap_from_taps(taps, coefs, inv_dx2)
+            env = {"omega": self.omega,
+                   "_lap_diag": self._lap_diag(level.dx)}
+            for i, n in enumerate(names):
+                env[n] = fs[i]
+                env["lap_" + n] = lap[i]
+                env[self.f_to_rho_dict[n]] = extras["rhos"][i]
+            for k in aux_lat:
+                env[k] = extras[k]
+            for k in aux_scal:
+                env[k] = scalars[k]
+            out = jnp.stack([
+                jnp.broadcast_to(
+                    jnp.asarray(evaluate(exprs[n], env), fs.dtype),
+                    fs.shape[1:])
+                for n in names])
+            return {"out": out}
+
+        st = None
+        if feasible:
+            extra_defs = {"rhos": (nf,), **{k: () for k in aux_lat}}
+            try:
+                st = StreamingStencil(
+                    local_shape, {"f": nf}, self.halo_shape, body,
+                    {"out": (nf,)}, extra_defs=extra_defs,
+                    scalar_names=tuple(aux_scal), dtype=dtype,
+                    x_halo=(px > 1), y_halo=(py > 1))
+            except ValueError:
+                if px == 1 and py == 1:
+                    try:
+                        st = ResidentStencil(
+                            local_shape, {"f": nf}, self.halo_shape,
+                            body, {"out": (nf,)}, extra_defs=extra_defs,
+                            scalar_names=tuple(aux_scal), dtype=dtype)
+                    except ValueError:
+                        st = None
+        if st is None:
+            self._compiled[key] = None
+            return None
+
+        halo = (self.halo_shape if px > 1 else 0, HY if py > 1 else 0, 0)
+        sharded = px > 1 or py > 1
+
+        def run(fstack, rhostack, aux_args, nu):
+            scalars = dict(zip(aux_scal, aux_args[len(aux_lat):]))
+            extras = {"rhos": rhostack,
+                      **dict(zip(aux_lat, aux_args[:len(aux_lat)]))}
+
+            def one(fst):
+                fin = (decomp.pad_with_halos(fst, halo) if sharded
+                       else fst)
+                return st(fin, scalars=scalars, extras=extras)["out"]
+
+            if kind != "smooth":
+                return one(fstack)
+            return lax.fori_loop(0, nu, lambda _, fst: one(fst), fstack)
+
+        if sharded:
+            spec = decomp.spec(1)
+            from jax.sharding import PartitionSpec as P
+            in_specs = (spec, spec,
+                        (spec,) * len(aux_lat) + (P(),) * len(aux_scal),
+                        P())
+            fn = jax.jit(decomp.shard_map(
+                run, in_specs, spec, check_vma=False))
+        else:
+            fn = jax.jit(run)
+
+        self._compiled[key] = fn
+        return fn
+
+    def _try_pallas(self, kind, level, fs, rhos, aux, decomp, nu=0):
+        if self.smoother != "pallas":
+            return None
+        names = list(self.f_to_rho_dict)
+        dtype = jnp.result_type(fs[names[0]])
+        aux_struct = self._aux_struct(aux)
+        fn = self._pallas_level(kind, level, decomp, dtype, aux_struct)
+        if fn is None:
+            return None  # cheap: no stacking before the feasibility gate
+        fstack = jnp.stack([fs[n] for n in names])
+        rhostack = jnp.stack(
+            [jnp.asarray(rhos[self.f_to_rho_dict[n]], dtype)
+             for n in names])
+        aux_args = tuple(aux[k] for k, kk in aux_struct
+                         if kk == "lattice")
+        aux_args += tuple(aux[k] for k, kk in aux_struct
+                          if kk == "scalar")
+        out = fn(fstack, rhostack, aux_args, jnp.int32(nu))
+        return {n: out[i] for i, n in enumerate(names)}
+
     def smooth(self, level, fs, rhos, aux, iterations, decomp=None):
         """Run ``iterations`` relaxation sweeps; returns updated unknowns."""
+        decomp = decomp if decomp is not None else self.decomp
+        fs, rhos, aux = self._cast(fs), self._cast(rhos), self._cast(aux)
+        res = self._try_pallas("smooth", level, fs, rhos, aux, decomp,
+                               nu=int(iterations))
+        if res is not None:
+            return res
         return self._get_compiled("smooth", level, int(iterations), decomp)(
-            self._cast(fs), self._cast(rhos), self._cast(aux))
+            fs, rhos, aux)
 
     def residual(self, level, fs, rhos, aux, decomp=None):
         """``rho - L(f)`` per unknown (reference relax.py:216-223)."""
+        decomp = decomp if decomp is not None else self.decomp
+        fs, rhos, aux = self._cast(fs), self._cast(rhos), self._cast(aux)
+        res = self._try_pallas("residual", level, fs, rhos, aux, decomp)
+        if res is not None:
+            return res
         return self._get_compiled("residual", level, None, decomp)(
-            self._cast(fs), self._cast(rhos), self._cast(aux))
+            fs, rhos, aux)
 
     def tau_rhs(self, level, fs, restricted_resid, aux, decomp=None):
         """Coarse-level rho with FAS tau-correction."""
         return self._get_compiled("tau", level, None, decomp)(
             self._cast(fs), self._cast(restricted_resid), self._cast(aux))
 
+    def error_arrays(self, level, fs, rhos, aux, decomp=None):
+        """Residual norms as DEVICE scalars — no host sync, so cycle
+        drivers can record errors without serializing the device queue
+        (they convert once at the end; multigrid/__init__.py)."""
+        r = self.residual(level, fs, rhos, aux, decomp)
+        return {n: [jnp.max(jnp.abs(rn)), jnp.sqrt(jnp.mean(rn * rn))]
+                for n, rn in r.items()}
+
     def get_error(self, level, fs, rhos, aux, decomp=None):
         """L-infinity and L2 norms of the residual per unknown (reference
         relax.py:242-266)."""
-        r = self.residual(level, fs, rhos, aux, decomp)
-        return {n: [float(jnp.max(jnp.abs(rn))),
-                    float(jnp.sqrt(jnp.mean(rn * rn)))]
-                for n, rn in r.items()}
+        return {n: [float(a), float(b)] for n, (a, b) in
+                self.error_arrays(level, fs, rhos, aux, decomp).items()}
 
     # -- standalone relaxation (reference __call__, relax.py:164-200) -------
 

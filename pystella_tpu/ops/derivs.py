@@ -370,7 +370,8 @@ class FiniteDifferencer:
         raise ValueError(name)
 
     def _pallas_op(self, name, n_comp, dtype, vector_in, global_shape):
-        from pystella_tpu.ops.pallas_stencil import StreamingStencil
+        from pystella_tpu.ops.pallas_stencil import (
+            ResidentStencil, StreamingStencil)
 
         key = ("pallas", name, n_comp, str(dtype), vector_in, global_shape)
         cached = self._sharded_cache.get(key)
@@ -387,8 +388,17 @@ class FiniteDifferencer:
                     "pdz": {"pd": (n_out,)},
                     "div": {"div": (n_out,)}}[name]
         body = self._pallas_bodies(name, n_out)
-        st = StreamingStencil(local_shape, {"f": n_comp}, self.h, body,
-                              out_defs, dtype=dtype, x_halo=(px > 1))
+        try:
+            st = StreamingStencil(local_shape, {"f": n_comp}, self.h, body,
+                                  out_defs, dtype=dtype, x_halo=(px > 1))
+        except ValueError:
+            if px > 1:
+                raise  # resident kernels assume local periodicity
+            # streaming infeasible (Z below the 128-lane tile, or no
+            # blocking): whole-lattice-resident kernel — all-roll taps,
+            # no windowed DMAs (fixes the wave-64^3-class cliff)
+            st = ResidentStencil(local_shape, {"f": n_comp}, self.h, body,
+                                 out_defs, dtype=dtype)
 
         if px > 1:
             h = self.h

@@ -44,8 +44,8 @@ from pystella_tpu import field as _field
 from pystella_tpu import step as _step
 from pystella_tpu.ops.derivs import _grad_coefs, _lap_coefs
 from pystella_tpu.ops.pallas_stencil import (
-    StreamingStencil, grad_from_taps as _grad_from_taps,
-    lap_from_taps as _lap_from_taps,
+    ResidentStencil, StreamingStencil,
+    grad_from_taps as _grad_from_taps, lap_from_taps as _lap_from_taps,
 )
 
 __all__ = ["FusedScalarStepper", "FusedPreheatStepper"]
@@ -84,7 +84,7 @@ class FusedScalarStepper(_step.Stepper):
     def __init__(self, sector, decomp, grid_shape, dx, halo_shape=2,
                  tableau=None, dtype=jnp.float32, bx=None, by=None,
                  dt=None, pair_stages=True, pair_bx=None, pair_by=None,
-                 interpret=None, donate=False, **kwargs):
+                 interpret=None, donate=False, resident=None, **kwargs):
         tableau = tableau or _step.LowStorageRK54
         self._A = tableau._A
         self._B = tableau._B
@@ -121,6 +121,7 @@ class FusedScalarStepper(_step.Stepper):
         self._pair_bx, self._pair_by = pair_bx, pair_by
         self._pair_call = None  # set by _build_kernels when pairing
         self._interpret = interpret
+        self._resident = resident
         self._build_kernels(bx, by)
 
         # jitted whole-step (one XLA computation, all stages fused).
@@ -140,6 +141,32 @@ class FusedScalarStepper(_step.Stepper):
         axis, and the interpret-mode override."""
         return {"x_halo": self._px > 1, "y_halo": self._py > 1,
                 "interpret": self._interpret}
+
+    def _build_stencil(self, win_defs, body, out_defs, extra_defs,
+                       scalar_names, bx=None, by=None, sum_defs=None):
+        """A stage kernel: streaming VMEM-ring windows when the lattice
+        admits them, else (single-device) the whole-lattice-resident
+        all-roll kernel — the Z < 128 small-lattice tier (VERDICT r3
+        #4). ``resident=True``/``False`` at construction forces the
+        choice."""
+        common = dict(extra_defs=extra_defs, scalar_names=scalar_names,
+                      dtype=self.dtype, sum_defs=sum_defs)
+        if not self._resident:
+            try:
+                return StreamingStencil(
+                    self.local_shape, win_defs, self.h, body, out_defs,
+                    bx=bx, by=by, **self._halo_kw, **common)
+            except ValueError:
+                # no resident fallback for sharded lattices (resident
+                # taps assume LOCAL periodicity) or explicitly pinned
+                # blockings (resident has no blocking to pin)
+                if (self._resident is False or self._px > 1
+                        or self._py > 1 or bx is not None
+                        or by is not None):
+                    raise
+        return ResidentStencil(self.local_shape, win_defs, self.h, body,
+                               out_defs, interpret=self._interpret,
+                               **common)
 
     def _try_pair_stencil(self, make):
         """Build the stage-pair kernel, degrading to single-stage kernels
@@ -165,13 +192,11 @@ class FusedScalarStepper(_step.Stepper):
         build their own fused kernel instead (so they don't pay for — or
         keep alive — a scalar-only kernel they never call)."""
         F = self.F
-        self._scalar_st = StreamingStencil(
-            self.local_shape, {"f": F}, self.h,
-            self._scalar_body, out_defs={
-                "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
-            extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
-            scalar_names=("dt", "a", "hubble", "A", "B"),
-            dtype=self.dtype, bx=bx, by=by, **self._halo_kw)
+        self._scalar_st = self._build_stencil(
+            {"f": F}, self._scalar_body,
+            {"f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
+            {"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
+            ("dt", "a", "hubble", "A", "B"), bx=bx, by=by)
         self._scalar_call = self._make_call(
             self._scalar_st, windows=("f",),
             extra_names=("dfdt", "kf", "kdfdt"))
@@ -190,16 +215,14 @@ class FusedScalarStepper(_step.Stepper):
             # kernel's VMEM footprint is ~2x; explicit bx/by apply to the
             # single-stage kernel only — use pair_bx/pair_by to pin this
             # one).
-            self._pair_st = self._try_pair_stencil(lambda: StreamingStencil(
-                self.local_shape,
-                {"f": F, "dfdt": F, "kf": F}, self.h,
-                self._pair_body, out_defs={
-                    "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
-                extra_defs={"kdfdt": (F,)},
-                scalar_names=("dt", "a1", "hubble1", "A1", "B1",
-                              "a2", "hubble2", "A2", "B2"),
-                dtype=self.dtype, bx=self._pair_bx, by=self._pair_by,
-                **self._halo_kw))
+            self._pair_st = self._try_pair_stencil(
+                lambda: self._build_stencil(
+                    {"f": F, "dfdt": F, "kf": F}, self._pair_body,
+                    {"f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
+                    {"kdfdt": (F,)},
+                    ("dt", "a1", "hubble1", "A1", "B1",
+                     "a2", "hubble2", "A2", "B2"),
+                    bx=self._pair_bx, by=self._pair_by))
             if self._pair_st is not None:
                 self._pair_call = self._make_call(
                     self._pair_st,
@@ -409,15 +432,14 @@ class FusedScalarStepper(_step.Stepper):
         state — same blocking, same arithmetic, zero extra HBM passes."""
         if self._es_call is None:
             F = self.F
-            st = StreamingStencil(
-                self.local_shape, {"f": F}, self.h,
+            st = self._build_stencil(
+                {"f": F},
                 lambda t, e, s: self._scalar_body(t, e, s, energy=True),
-                out_defs={
-                    "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
-                extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
-                scalar_names=("dt", "a", "hubble", "A", "B"),
-                dtype=self.dtype, bx=self._scalar_st.bx,
-                by=self._scalar_st.by, **self._halo_kw,
+                {"f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
+                {"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
+                ("dt", "a", "hubble", "A", "B"),
+                bx=getattr(self._scalar_st, "bx", None),
+                by=getattr(self._scalar_st, "by", None),
                 sum_defs={"esums": 2 * F + 1})
             self._es_call = self._make_call(
                 st, windows=("f",), extra_names=("dfdt", "kf", "kdfdt"))
@@ -699,15 +721,13 @@ class FusedPreheatStepper(FusedScalarStepper):
 
     def _build_kernels(self, bx, by):
         F, H = self.F, self.n_hij
-        self._both_st = StreamingStencil(
-            self.local_shape, {"f": F, "hij": H}, self.h,
-            self._preheat_body, out_defs={
-                "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,),
-                "hij": (H,), "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
-            extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,),
-                        "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
-            scalar_names=("dt", "a", "hubble", "A", "B"),
-            dtype=self.dtype, bx=bx, by=by, **self._halo_kw)
+        self._both_st = self._build_stencil(
+            {"f": F, "hij": H}, self._preheat_body,
+            {"f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+             "hij": (H,), "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
+            {"dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+             "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
+            ("dt", "a", "hubble", "A", "B"), bx=bx, by=by)
         self._both_call = self._make_call(
             self._both_st, windows=("f", "hij"),
             extra_names=("dfdt", "kf", "kdfdt",
@@ -718,19 +738,17 @@ class FusedPreheatStepper(FusedScalarStepper):
             # window (f/dfdt/kf feed lap+grad of f1; hij/dhijdt/khij feed
             # lap of h1); the k-derivative carries are offset-0 only and
             # stay blockwise extras
-            self._pair_st = self._try_pair_stencil(lambda: StreamingStencil(
-                self.local_shape,
-                {"f": F, "dfdt": F, "kf": F,
-                 "hij": H, "dhijdt": H, "khij": H}, self.h,
-                self._pair_body, out_defs={
-                    "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,),
-                    "hij": (H,), "dhijdt": (H,), "khij": (H,),
-                    "kdhijdt": (H,)},
-                extra_defs={"kdfdt": (F,), "kdhijdt": (H,)},
-                scalar_names=("dt", "a1", "hubble1", "A1", "B1",
-                              "a2", "hubble2", "A2", "B2"),
-                dtype=self.dtype, bx=self._pair_bx, by=self._pair_by,
-                **self._halo_kw))
+            self._pair_st = self._try_pair_stencil(
+                lambda: self._build_stencil(
+                    {"f": F, "dfdt": F, "kf": F,
+                     "hij": H, "dhijdt": H, "khij": H}, self._pair_body,
+                    {"f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+                     "hij": (H,), "dhijdt": (H,), "khij": (H,),
+                     "kdhijdt": (H,)},
+                    {"kdfdt": (F,), "kdhijdt": (H,)},
+                    ("dt", "a1", "hubble1", "A1", "B1",
+                     "a2", "hubble2", "A2", "B2"),
+                    bx=self._pair_bx, by=self._pair_by))
             if self._pair_st is not None:
                 self._pair_call = self._make_call(
                     self._pair_st,
@@ -857,18 +875,17 @@ class FusedPreheatStepper(FusedScalarStepper):
     def _ensure_energy_call(self):
         if self._es_call is None:
             F, H = self.F, self.n_hij
-            st = StreamingStencil(
-                self.local_shape, {"f": F, "hij": H}, self.h,
+            st = self._build_stencil(
+                {"f": F, "hij": H},
                 lambda t, e, s: self._preheat_body(t, e, s, energy=True),
-                out_defs={
-                    "f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,),
-                    "hij": (H,), "dhijdt": (H,), "khij": (H,),
-                    "kdhijdt": (H,)},
-                extra_defs={"dfdt": (F,), "kf": (F,), "kdfdt": (F,),
-                            "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
-                scalar_names=("dt", "a", "hubble", "A", "B"),
-                dtype=self.dtype, bx=self._both_st.bx,
-                by=self._both_st.by, **self._halo_kw,
+                {"f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+                 "hij": (H,), "dhijdt": (H,), "khij": (H,),
+                 "kdhijdt": (H,)},
+                {"dfdt": (F,), "kf": (F,), "kdfdt": (F,),
+                 "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
+                ("dt", "a", "hubble", "A", "B"),
+                bx=getattr(self._both_st, "bx", None),
+                by=getattr(self._both_st, "by", None),
                 sum_defs={"esums": 2 * F + 1})
             self._es_call = self._make_call(
                 st, windows=("f", "hij"),

@@ -40,8 +40,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["StreamingStencil", "Taps", "HY", "LANE", "choose_blocks",
-           "lap_from_taps", "grad_from_taps"]
+__all__ = ["StreamingStencil", "ResidentStencil", "Taps", "HY", "LANE",
+           "choose_blocks", "lap_from_taps", "grad_from_taps"]
 
 #: aligned y-halo width (one sublane tile); must be >= the stencil radius
 HY = 8
@@ -187,6 +187,157 @@ def grad_from_taps(taps, coefs, inv_dx):
             acc = acc + c * inv_dx[d] * (taps(*plus) - taps(*minus))
         grads.append(acc)
     return grads
+
+
+class RollTaps:
+    """Taps accessor for :class:`ResidentStencil`: the whole lattice is a
+    VMEM value, every shift is a periodic in-register roll along any of
+    the three trailing axes (memoized per offset). Matches the
+    :class:`Taps` indexing convention: ``taps(s)[..., i, ...] ==
+    f[..., i + s, ...]`` with periodic wrap."""
+
+    def __init__(self, w, interpret):
+        self._w = w
+        self._interpret = interpret
+        self._cache = {}
+
+    def _roll1(self, arr, s, axis):
+        if s == 0:
+            return arr
+        if self._interpret:
+            return jnp.roll(arr, -s, axis)
+        n = arr.shape[axis]
+        return pltpu.roll(arr, (n - s) % n, axis)
+
+    def __call__(self, sx=0, sy=0, sz=0):
+        key = (sx, sy, sz)
+        if key in self._cache:
+            return self._cache[key]
+        out = self._roll1(self._roll1(self._roll1(
+            self._w, sx, 1), sy, 2), sz, 3)
+        self._cache[key] = out
+        return out
+
+    def roll(self, arr, sz):
+        """Periodic z-shift of a computed block (same contract as
+        :meth:`Taps.roll`)."""
+        return self._roll1(arr, sz, 3)
+
+
+class ResidentStencil:
+    """Whole-lattice-resident Pallas kernels for small lattices.
+
+    The streaming kernels require ``Z % 128 == 0`` (lane-aligned window
+    DMAs); below that the XLA fallback ran at ~5% of the fused path
+    (wave-64**3, doc/performance.md). Here the full ``(C, X, Y, Z)``
+    arrays are pallas_call inputs placed in VMEM (no grid, no windows,
+    no DMA choreography), stencil taps are periodic in-register rolls on
+    all three axes, and the body — the same body the streaming kernels
+    take — runs once over the whole lattice: one HBM read + one write
+    per array with zero relayouts. Feasible whenever all inputs,
+    outputs, and ~3 body temporaries fit the VMEM ``budget``.
+
+    Interface-compatible with :class:`StreamingStencil` (``__call__``,
+    ``out_defs``/``sum_defs``, scalars via SMEM) so fused steppers and
+    ``FiniteDifferencer`` can select it per lattice shape.
+    """
+
+    def __init__(self, lattice_shape, win_defs, h, body, out_defs,
+                 extra_defs=None, scalar_names=(), dtype=jnp.float32,
+                 interpret=None, sum_defs=None, budget=32 * 2**20):
+        self.lattice_shape = X, Y, Z = tuple(int(s) for s in lattice_shape)
+        if not isinstance(win_defs, dict):
+            win_defs = {"f": int(win_defs)}
+        self.win_defs = {k: int(v) for k, v in win_defs.items()}
+        self.single_window = len(self.win_defs) == 1
+        self.h = int(h)
+        self.body = body
+        self.out_defs = {k: tuple(v) for k, v in dict(out_defs).items()}
+        self.sum_defs = {k: int(v) for k, v in dict(sum_defs or {}).items()}
+        self.extra_defs = {k: tuple(v)
+                           for k, v in dict(extra_defs or {}).items()}
+        self.scalar_names = tuple(scalar_names)
+        self.dtype = jnp.zeros((), dtype).dtype
+        self.interpret = _is_cpu() if interpret is None else interpret
+
+        nwin = sum(self.win_defs.values())
+        nio = (nwin + sum(int(np.prod(s)) if s else 1
+                          for s in self.extra_defs.values())
+               + sum(int(np.prod(s)) if s else 1
+                     for s in self.out_defs.values()))
+        need = (nio + 3 * nwin) * X * Y * Z * self.dtype.itemsize
+        if need > budget:
+            raise ValueError(
+                f"resident stencil on lattice {self.lattice_shape} with "
+                f"{nio} lattice arrays (+~3 temps) needs ~"
+                f"{need / 2**20:.0f} MB VMEM > the {budget / 2**20:.0f} MB "
+                "budget; use the streaming kernels or the halo path")
+        self._call = self._build()
+
+    def _build(self):
+        nw, ns = len(self.win_defs), len(self.scalar_names)
+        ne, no = len(self.extra_defs), len(self.out_defs)
+        X, Y, Z = self.lattice_shape
+
+        def kernel(*refs):
+            f_refs = refs[:nw]
+            scalar_refs = refs[nw:nw + ns]
+            extra_refs = refs[nw + ns:nw + ns + ne]
+            out_refs = refs[nw + ns + ne:]
+            taps = {n: RollTaps(r[...], self.interpret)
+                    for n, r in zip(self.win_defs, f_refs)}
+            if self.single_window:
+                taps = next(iter(taps.values()))
+            scalars = {n: r[0]
+                       for n, r in zip(self.scalar_names, scalar_refs)}
+            extras = {n: r[...]
+                      for n, r in zip(self.extra_defs, extra_refs)}
+            outs = self.body(taps, extras, scalars)
+            for n, ref in zip(self.out_defs, out_refs[:no]):
+                ref[...] = outs[n]
+            for n, ref in zip(self.sum_defs, out_refs[no:]):
+                ref[...] = outs[n].reshape(self.sum_defs[n], 1)
+
+        def whole(lead):
+            shape = tuple(lead) + self.lattice_shape
+            return pl.BlockSpec(shape, lambda n=len(shape): (0,) * n)
+
+        in_specs = [whole((C,)) for C in self.win_defs.values()]
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)
+                     for _ in self.scalar_names]
+        in_specs += [whole(lead) for lead in self.extra_defs.values()]
+        out_specs = [whole(lead) for lead in self.out_defs.values()]
+        out_shapes = [jax.ShapeDtypeStruct(lead + self.lattice_shape,
+                                           self.dtype)
+                      for lead in self.out_defs.values()]
+        for nt in self.sum_defs.values():
+            out_specs.append(pl.BlockSpec((nt, 1), lambda: (0, 0)))
+            out_shapes.append(jax.ShapeDtypeStruct((nt, 1), self.dtype))
+        return pl.pallas_call(
+            kernel,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=self.interpret,
+        )
+
+    def __call__(self, f, scalars=None, extras=None):
+        """Apply to the full-lattice input(s); same contract as
+        :meth:`StreamingStencil.__call__` (sum outputs reduced to
+        ``(nterms,)``)."""
+        scalars = scalars or {}
+        extras = extras or {}
+        win_args = ([f[n] for n in self.win_defs] if isinstance(f, dict)
+                    else [f])
+        scalar_args = [jnp.asarray(scalars[n], self.dtype).reshape(1)
+                       for n in self.scalar_names]
+        extra_args = [extras[n] for n in self.extra_defs]
+        res = self._call(*win_args, *scalar_args, *extra_args)
+        out = {}
+        names = list(self.out_defs) + list(self.sum_defs)
+        for n, arr in zip(names, res):
+            out[n] = arr.reshape(-1) if n in self.sum_defs else arr
+        return out
 
 
 class StreamingStencil:

@@ -82,15 +82,38 @@ def test_z_decomposition_roundtrip(decomp, grid_shape, proc_shape):
 
 
 @pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
-def test_replicate_fallback_when_pencils_infeasible(decomp, proc_shape,
-                                                    caplog):
+def test_partial_pencil_when_total_count_does_not_divide(decomp,
+                                                         proc_shape):
     """Grids divisible per mesh axis but not by the total device count
-    replicate-transform (correct, warned once at construction)."""
-    import logging
+    take the partial-replication pencil scheme (VERDICT r3 #7: the old
+    behavior silently replicated — an OOM cliff at production sizes;
+    now each FFT stage shards its long axis by one mesh axis)."""
+    if proc_shape != (2, 2, 1):
+        pytest.skip("scheme choice pinned on the (2, 2, 1) mesh")
     grid_shape = (6, 6, 8)  # 6 % 2 == 0 (shardable) but 6 % 4 != 0
+    fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
+    assert fft._scheme == "partial"
+
+    rng = np.random.default_rng(8)
+    fx = rng.random(grid_shape)
+    fk = fft.dft(decomp.shard(fx))
+    assert np.allclose(np.asarray(fk), np.fft.rfftn(fx), atol=1e-10)
+    assert np.allclose(np.asarray(fft.idft(fk)), fx, atol=1e-12)
+
+
+def test_replicate_fallback_when_pencils_infeasible(make_decomp, caplog):
+    """Meshes no distributed scheme serves (here z-sharded with x/y not
+    dividing the total count) replicate-transform: correct and warned
+    for small grids, a hard error above the replicate limit."""
+    import logging
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    decomp = make_decomp((2, 1, 2))
+    grid_shape = (6, 6, 8)  # 6 % 4 != 0 and z sharded -> no pencil tier
     with caplog.at_level(logging.WARNING, "pystella_tpu.fourier.dft"):
         fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
-    assert not fft._pencil_ok
+    assert fft._scheme == "replicate"
     assert any("REPLICATE" in r.message for r in caplog.records)
 
     rng = np.random.default_rng(8)
@@ -98,6 +121,15 @@ def test_replicate_fallback_when_pencils_infeasible(decomp, proc_shape,
     fk = fft.dft(decomp.shard(fx))
     assert np.allclose(np.asarray(fk), np.fft.rfftn(fx), atol=1e-10)
     assert np.allclose(np.asarray(fft.idft(fk)), fx, atol=1e-12)
+
+    # production-size replicate is an OOM cliff: construction refuses
+    # (no arrays are allocated — the check is on the estimated size)
+    with pytest.raises(ValueError, match="replicate"):
+        ps.DFT(decomp, grid_shape=(514, 514, 514), dtype=np.float32)
+    # ... unless explicitly accepted
+    fft_big = ps.DFT(decomp, grid_shape=(514, 514, 514),
+                     dtype=np.float32, allow_replicate=True)
+    assert fft_big._scheme == "replicate"
 
 
 def test_make_hermitian_enforces_symmetry():

@@ -653,3 +653,66 @@ if __name__ == "__main__":
     # (8 arrays), x 2 fields
     common.report("fused RK54 step", ms,
                   nbytes=(8 * 2 + 8) * 2 * nsites * isize, nsites=nsites)
+
+
+def test_fused_scalar_resident_matches_streaming(decomp):
+    """resident=True forces the whole-lattice-resident stage kernels
+    (the compiled Z < 128 tier); same arithmetic, same results as the
+    streaming-window kernels, including pairing and the energy-coupled
+    chunk."""
+    grid_shape = (16, 16, 16)
+    h, dx, dt = 2, (0.3, 0.25, 0.2), 0.01
+    rng = np.random.default_rng(33)
+    state = {
+        "f": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.01 * rng.standard_normal((2,) + grid_shape)),
+    }
+    args = {"a": 1.3, "hubble": 0.21}
+    sector = ps.ScalarSector(2, potential=_potential)
+
+    stream = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                                dtype=jnp.float64, bx=4, by=8, **_XKW)
+    res = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                             dtype=jnp.float64, resident=True, **_XKW)
+    from pystella_tpu.ops.pallas_stencil import ResidentStencil
+    assert isinstance(res._scalar_st, ResidentStencil)
+    assert isinstance(res._pair_st, ResidentStencil)
+
+    got = res.step(state, 0.0, dt, args)
+    ref = stream.step(state, 0.0, dt, args)
+    for name in ("f", "dfdt"):
+        err = np.max(np.abs(np.asarray(got[name]) - np.asarray(ref[name])))
+        scale = np.max(np.abs(np.asarray(ref[name])))
+        assert err / scale < 1e-13, f"{name}: resident diverges ({err})"
+
+    # energy-coupled chunk through the resident es kernel
+    expand_r = ps.Expansion(1e-3, ps.LowStorageRK54)
+    expand_s = ps.Expansion(1e-3, ps.LowStorageRK54)
+    got_c = res.coupled_multi_step(
+        {k: _arr(np.asarray(v)) for k, v in state.items()}, 2, expand_r,
+        0.0, dt)
+    ref_c = stream.coupled_multi_step(
+        {k: _arr(np.asarray(v)) for k, v in state.items()}, 2, expand_s,
+        0.0, dt)
+    for name in ("f", "dfdt"):
+        err = np.max(np.abs(np.asarray(got_c[name])
+                            - np.asarray(ref_c[name])))
+        scale = np.max(np.abs(np.asarray(ref_c[name])))
+        assert err / scale < 1e-12, f"{name}: resident coupled ({err})"
+    assert abs(expand_r.a - expand_s.a) / expand_s.a < 1e-13
+
+
+def test_fused_resident_auto_small_y(decomp):
+    """Lattices with no feasible streaming blocking (y not a multiple of
+    8) now auto-select the resident tier instead of failing."""
+    from pystella_tpu.ops.pallas_stencil import ResidentStencil
+
+    grid_shape = (12, 12, 12)
+    sector = ps.ScalarSector(1, potential=lambda f: 0.5 * f[0] ** 2)
+    st = FusedScalarStepper(sector, decomp, grid_shape, 0.3, 2,
+                            dtype=jnp.float64, **_XKW)
+    assert isinstance(st._scalar_st, ResidentStencil)
+    state = {"f": _arr(0.1 * np.random.default_rng(3).standard_normal(
+        (1,) + grid_shape)), "dfdt": _arr(np.zeros((1,) + grid_shape))}
+    out = st.step(state, 0.0, 0.01, {"a": 1.0, "hubble": 0.0})
+    assert np.all(np.isfinite(np.asarray(out["f"])))

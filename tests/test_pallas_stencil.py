@@ -214,3 +214,79 @@ def test_finitedifferencer_pallas_sharded_x():
     assert np.max(np.abs(out - ref)) / np.max(np.abs(ref)) < 1e-12
     g = np.asarray(fd.grad(x))
     assert g.shape == (2, 3, 16, 16, 16)
+
+
+@interpret_only
+@pytest.mark.parametrize("shape", [(16, 16, 16), (8, 24, 12),
+                                   (32, 32, 64)])
+def test_resident_lap_matches_numpy(shape):
+    """Whole-lattice-resident kernels (all-roll taps, no windows) match
+    numpy on lattices the streaming kernels cannot compile for
+    (Z % 128 != 0 — the wave-64^3-class small-lattice regime)."""
+    from pystella_tpu.ops.pallas_stencil import ResidentStencil
+
+    F, h = 2, 2
+    dx = 0.37
+    coefs = _lap_coefs[h]
+    rng = np.random.default_rng(7)
+    f = jnp.asarray(rng.standard_normal((F,) + shape))
+
+    st = ResidentStencil(shape, F, h, _lap_body(coefs, dx),
+                         {"lap": (F,)}, dtype=jnp.float64)
+    out = np.asarray(st(f)["lap"])
+    ref = _numpy_lap(np.asarray(f), coefs, dx)
+    assert np.max(np.abs(out - ref)) / np.max(np.abs(ref)) < 1e-12
+
+
+@interpret_only
+def test_resident_extras_scalars_sums():
+    """Extras, SMEM scalars, and lattice-sum outputs on the resident
+    kernel (the energy-emitting fused-stage contract)."""
+    from pystella_tpu.ops.pallas_stencil import ResidentStencil
+
+    F, N = 2, 12
+    rng = np.random.default_rng(8)
+    f = jnp.asarray(rng.standard_normal((F, N, N, N)))
+    g = jnp.asarray(rng.standard_normal((F, N, N, N)))
+
+    def body(taps, extras, scalars):
+        v = taps() * scalars["alpha"] + extras["g"]
+        return {"out": v, "sums": jnp.sum(v * v, axis=(1, 2, 3))}
+
+    st = ResidentStencil((N, N, N), F, 1, body, {"out": (F,)},
+                         extra_defs={"g": (F,)}, scalar_names=("alpha",),
+                         dtype=jnp.float64, sum_defs={"sums": F})
+    res = st(f, scalars={"alpha": 1.5}, extras={"g": g})
+    ref = 1.5 * np.asarray(f) + np.asarray(g)
+    assert np.allclose(np.asarray(res["out"]), ref)
+    assert np.allclose(np.asarray(res["sums"]),
+                       (ref * ref).sum(axis=(1, 2, 3)))
+
+
+def test_resident_budget_guard():
+    """Over-budget lattices are rejected with a clear error (callers fall
+    back to the streaming or halo tiers)."""
+    from pystella_tpu.ops.pallas_stencil import ResidentStencil
+
+    with pytest.raises(ValueError, match="VMEM"):
+        ResidentStencil((256, 256, 256), 4, 2,
+                        lambda t, e, s: {"out": t()}, {"out": (4,)},
+                        dtype=jnp.float32)
+
+
+@interpret_only
+def test_finitedifferencer_resident_small_z():
+    """FiniteDifferencer's pallas tier serves Z < 128 lattices through
+    the resident kernel (VERDICT r3 #4: the 64^3 cliff) — grad and lap
+    agree with the halo path."""
+    import pystella_tpu as ps
+
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+    fd = ps.FiniteDifferencer(decomp, 2, 0.3, mode="pallas")
+    fd_ref = ps.FiniteDifferencer(decomp, 2, 0.3, mode="halo")
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 64)))
+    for name in ("lap", "grad"):
+        got = np.asarray(getattr(fd, name)(x))
+        ref = np.asarray(getattr(fd_ref, name)(x))
+        assert np.max(np.abs(got - ref)) < 1e-11, name
