@@ -41,7 +41,8 @@ from pystella_tpu.models import (
     get_rho_and_p, Expansion,
 )
 from pystella_tpu.utils import (Checkpointer, HealthMonitor,
-    SimulationDiverged, OutputFile, StepTimer, timer, trace)
+    SimulationDiverged, OutputFile, ShardedSnapshot, StepTimer, timer,
+    trace)
 from pystella_tpu.step import (
     Stepper, RungeKuttaStepper, LowStorageRKStepper, compile_rhs_dict,
     RungeKutta4, RungeKutta3Heun, RungeKutta3Nystrom, RungeKutta3Ralston,
@@ -94,7 +95,8 @@ __all__ = [
     "Projector", "PowerSpectra", "RayleighGenerator",
     "SpectralCollocator", "SpectralPoissonSolver",
     "Sector", "ScalarSector", "TensorPerturbationSector", "tensor_index",
-    "get_rho_and_p", "Expansion", "OutputFile", "timer", "Checkpointer",
+    "get_rho_and_p", "Expansion", "OutputFile", "ShardedSnapshot",
+    "timer", "Checkpointer",
     "HealthMonitor", "SimulationDiverged", "StepTimer", "trace",
     "Stepper", "RungeKuttaStepper", "LowStorageRKStepper", "compile_rhs_dict",
     "RungeKutta4", "RungeKutta3Heun", "RungeKutta3Nystrom",

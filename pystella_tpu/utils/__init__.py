@@ -1,7 +1,8 @@
 from pystella_tpu.utils.checkpoint import Checkpointer
 from pystella_tpu.utils.monitor import HealthMonitor, SimulationDiverged
-from pystella_tpu.utils.output import OutputFile
+from pystella_tpu.utils.output import OutputFile, ShardedSnapshot
 from pystella_tpu.utils.profiling import StepTimer, timer, trace
 
 __all__ = ["Checkpointer", "HealthMonitor", "SimulationDiverged",
-           "OutputFile", "StepTimer", "timer", "trace"]
+           "OutputFile", "ShardedSnapshot", "StepTimer", "timer",
+           "trace"]

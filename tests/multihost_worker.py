@@ -12,7 +12,8 @@ virtual CPU devices, and exercise the multihost verbs end to end:
 - the pencil DFT over the 2-host mesh against ``np.fft.rfftn``,
 - a lattice-wide reduction and ``sync_hosts``.
 
-Usage: ``python multihost_worker.py <coordinator_addr> <process_id>``.
+Usage: ``python multihost_worker.py <coordinator_addr> <process_id>
+<snapshot_dir>``.
 """
 
 import os
@@ -33,6 +34,9 @@ jax.config.update("jax_enable_x64", True)
 
 
 def main():
+    if len(sys.argv) < 4:
+        sys.exit("usage: multihost_worker.py <coordinator_addr> "
+                 "<process_id> <snapshot_dir>")
     coordinator, process_id = sys.argv[1], int(sys.argv[2])
 
     import numpy as np
@@ -96,6 +100,25 @@ def main():
     # -- lattice-wide reduction (replicated result) + barrier ---------------
     total = jax.jit(lambda x: x.sum())(global_arr)
     np.testing.assert_allclose(float(total), full.sum(), rtol=1e-13)
+
+    # -- pod-scale sharded snapshot + rank-0 time series --------------------
+    # each process writes ONLY the shards it addresses (its x-slab) to its
+    # own file — no cross-host gather — then rank 0 reassembles the global
+    # field and appends a time-series record (the reference's pod output
+    # path is a full Gatherv to rank 0, decomp.py:536-599)
+    snap_dir = sys.argv[3]
+    with ps.ShardedSnapshot(snap_dir) as snap:
+        snap.save(5, f=global_arr)
+    mh.sync_hosts("snapshot-written")
+    if process_id == 0:
+        loaded = ps.ShardedSnapshot.load(snap_dir, 5)
+        np.testing.assert_array_equal(loaded["f"], full)
+        out = ps.OutputFile(name=os.path.join(snap_dir, "series"))
+        out.output("energy", total=float(total))
+        out.close()
+        import h5py
+        with h5py.File(os.path.join(snap_dir, "series.h5"), "r") as f:
+            assert f["energy/total"].shape[0] == 1
 
     mh.sync_hosts("test-done")
     print(f"worker {process_id}: OK", flush=True)

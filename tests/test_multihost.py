@@ -26,7 +26,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_cluster():
+def test_two_process_cluster(tmp_path):
     coordinator = f"localhost:{_free_port()}"
 
     env = dict(os.environ)
@@ -38,7 +38,8 @@ def test_two_process_cluster():
 
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, coordinator, str(i)],
+            [sys.executable, WORKER, coordinator, str(i),
+             str(tmp_path / "snaps")],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for i in range(2)]

@@ -9,19 +9,31 @@ import pystella_tpu as ps
 from pystella_tpu.fourier import tensor_index as tid
 
 
+#: per-dtype identity tolerance: f64 runs at machine precision; f32 is
+#: the TPU production precision (reference parametrizes dtypes the same
+#: way, test_derivs.py:101-102) — c64 arithmetic over ~32^3 modes leaves
+#: ~1e-5 max relative error in the projector identities
+TOL = {np.dtype(np.float64): 1e-11, np.dtype(np.float32): 5e-5}
+
+
+@pytest.fixture(params=[np.float64, np.float32], ids=["f64", "f32"])
+def dtype(request):
+    return np.dtype(request.param)
+
+
 @pytest.fixture
-def setup(proc_shape, grid_shape, make_decomp):
+def setup(proc_shape, grid_shape, make_decomp, dtype):
     decomp = make_decomp((proc_shape[0], proc_shape[1], 1))
-    lattice = ps.Lattice(grid_shape, (3.0, 4.0, 5.0), dtype=np.float64)
-    fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
-    return decomp, lattice, fft
+    lattice = ps.Lattice(grid_shape, (3.0, 4.0, 5.0), dtype=dtype)
+    fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=dtype)
+    return decomp, lattice, fft, TOL[dtype]
 
 
 def random_vector_k(fft, seed=5):
     rng = np.random.default_rng(seed)
     shape = (3,) + fft.shape(True)
     return (rng.standard_normal(shape)
-            + 1j * rng.standard_normal(shape))
+            + 1j * rng.standard_normal(shape)).astype(fft.cdtype)
 
 
 def eff_k_grids(proj):
@@ -32,7 +44,7 @@ def eff_k_grids(proj):
 @pytest.mark.parametrize("h", [0, 1, 2])
 @pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
 def test_transversify(setup, h, proc_shape):
-    decomp, lattice, fft = setup
+    decomp, lattice, fft, tol = setup
     proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
 
     vec = decomp.shard(random_vector_k(fft))
@@ -41,17 +53,17 @@ def test_transversify(setup, h, proc_shape):
     kx, ky, kz = eff_k_grids(proj)
     div = kx * vec_t[0] + ky * vec_t[1] + kz * vec_t[2]
     scale = np.abs(np.asarray(vec)).max()
-    assert np.abs(div).max() / scale < 1e-12
+    assert np.abs(div).max() / scale < tol
 
     # idempotent
     vec_t2 = np.asarray(proj.transversify(decomp.shard(vec_t)))
-    assert np.allclose(vec_t2, vec_t, atol=1e-12)
+    assert np.allclose(vec_t2, vec_t, atol=tol)
 
 
 @pytest.mark.parametrize("h", [0, 2])
 @pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
 def test_vec_pol_roundtrip(setup, h, proc_shape):
-    decomp, lattice, fft = setup
+    decomp, lattice, fft, tol = setup
     proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
 
     vec = decomp.shard(random_vector_k(fft))
@@ -60,18 +72,18 @@ def test_vec_pol_roundtrip(setup, h, proc_shape):
 
     # pol_to_vec(vec_to_pol(v)) equals the transverse part of v
     vec_t = np.asarray(proj.transversify(vec))
-    assert np.allclose(np.asarray(back), vec_t, atol=1e-11)
+    assert np.allclose(np.asarray(back), vec_t, atol=tol)
 
     # and projecting again to polarizations is the identity
     plus2, minus2 = proj.vec_to_pol(back)
-    assert np.allclose(np.asarray(plus2), np.asarray(plus), atol=1e-11)
-    assert np.allclose(np.asarray(minus2), np.asarray(minus), atol=1e-11)
+    assert np.allclose(np.asarray(plus2), np.asarray(plus), atol=tol)
+    assert np.allclose(np.asarray(minus2), np.asarray(minus), atol=tol)
 
 
 @pytest.mark.parametrize("h", [0, 2])
 @pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
 def test_vector_decomposition_roundtrip(setup, h, proc_shape):
-    decomp, lattice, fft = setup
+    decomp, lattice, fft, tol = setup
     proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
 
     vec_host = random_vector_k(fft)
@@ -91,18 +103,19 @@ def test_vector_decomposition_roundtrip(setup, h, proc_shape):
         mask = np.broadcast_to(
             (kx**2 + ky**2 + kz**2) > 1e-20, vec_host[0].shape)
         diff = np.abs(np.asarray(back) - vec_host)[:, mask]
-        assert diff.max() < 1e-11, f"times_abs_k={times_abs_k}"
+        assert diff.max() < tol, f"times_abs_k={times_abs_k}"
 
 
 @pytest.mark.parametrize("h", [0, 1, 2])
 @pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1)], indirect=True)
 def test_transverse_traceless(setup, h, proc_shape):
-    decomp, lattice, fft = setup
+    decomp, lattice, fft, tol = setup
     proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
 
     rng = np.random.default_rng(7)
     shape = (6,) + fft.shape(True)
-    hij = rng.standard_normal(shape) + 1j * rng.standard_normal(shape)
+    hij = (rng.standard_normal(shape)
+           + 1j * rng.standard_normal(shape)).astype(fft.cdtype)
     hij_tt = np.asarray(proj.transverse_traceless(decomp.shard(hij)))
 
     scale = np.abs(hij).max()
@@ -111,30 +124,32 @@ def test_transverse_traceless(setup, h, proc_shape):
 
     # traceless
     trace = sum(hij_tt[tid(a, a)] for a in range(1, 4))
-    assert np.abs(trace).max() / scale < 1e-12
+    assert np.abs(trace).max() / scale < tol
 
     # transverse: k_a h_ab = 0 for each b
     for b in range(1, 4):
         div = sum(kvec[a - 1] * hij_tt[tid(a, b)] for a in range(1, 4))
-        assert np.abs(div).max() / scale < 1e-11
+        assert np.abs(div).max() / scale < tol
 
     # idempotent
     hij_tt2 = np.asarray(proj.transverse_traceless(decomp.shard(hij_tt)))
-    assert np.allclose(hij_tt2, hij_tt, atol=1e-11)
+    assert np.allclose(hij_tt2, hij_tt, atol=tol)
 
 
 @pytest.mark.parametrize("h", [0, 2])
 @pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
 def test_tensor_pol_roundtrip(setup, h, proc_shape):
-    decomp, lattice, fft = setup
+    decomp, lattice, fft, tol = setup
     proj = ps.Projector(fft, h, lattice.dk, lattice.dx)
 
     rng = np.random.default_rng(8)
     kshape = fft.shape(True)
-    plus = decomp.shard(rng.standard_normal(kshape)
-                        + 1j * rng.standard_normal(kshape))
-    minus = decomp.shard(rng.standard_normal(kshape)
+    plus = decomp.shard((rng.standard_normal(kshape)
                          + 1j * rng.standard_normal(kshape))
+                        .astype(fft.cdtype))
+    minus = decomp.shard((rng.standard_normal(kshape)
+                          + 1j * rng.standard_normal(kshape))
+                         .astype(fft.cdtype))
 
     hij = proj.pol_to_tensor(plus, minus)
     plus2, minus2 = proj.tensor_to_pol(hij)
@@ -142,13 +157,13 @@ def test_tensor_pol_roundtrip(setup, h, proc_shape):
     # roundtrip away from zeroed momenta
     kx, ky, kz = eff_k_grids(proj)
     mask = np.broadcast_to((kx**2 + ky**2 + kz**2) > 1e-20, kshape)
-    assert np.abs(np.asarray(plus2) - np.asarray(plus))[mask].max() < 1e-11
-    assert np.abs(np.asarray(minus2) - np.asarray(minus))[mask].max() < 1e-11
+    assert np.abs(np.asarray(plus2) - np.asarray(plus))[mask].max() < tol
+    assert np.abs(np.asarray(minus2) - np.asarray(minus))[mask].max() < tol
 
     # the constructed tensor is automatically TT
     hij_tt = np.asarray(proj.transverse_traceless(hij))
     diff = np.abs(hij_tt - np.asarray(hij))[:, mask]
-    assert diff.max() < 1e-11
+    assert diff.max() < tol
 
 
 if __name__ == "__main__":

