@@ -220,7 +220,8 @@ class RelaxationBase:
         (z-sharded, sublane-infeasible sharded y, over-budget resident)
         — callers fall back to the XLA path."""
         from pystella_tpu.ops.pallas_stencil import (
-            HY, ResidentStencil, StreamingStencil, lap_from_taps)
+            HY, ResidentStencil, StreamingStencil, lap_from_taps,
+            sharded_halo)
 
         key = ("pallas", kind, level, decomp, str(dtype), aux_struct)
         if key in self._compiled:
@@ -283,7 +284,7 @@ class RelaxationBase:
             self._compiled[key] = None
             return None
 
-        halo = (self.halo_shape if px > 1 else 0, HY if py > 1 else 0, 0)
+        halo = sharded_halo(self.halo_shape, px, py)
         sharded = px > 1 or py > 1
 
         def run(fstack, rhostack, aux_args, nu):

@@ -182,20 +182,19 @@ class FiniteDifferencer:
         if mode == "auto":
             # pallas only on TPU (Mosaic is TPU-only; on CPU it would run
             # in slow interpret mode — tests opt in explicitly)
-            py, pz = decomp.proc_shape[1], decomp.proc_shape[2]
+            pz = decomp.proc_shape[2]
             mode = "pallas" if (jax.default_backend() == "tpu"
-                                and py == 1 and pz == 1
-                                and self.h <= 8) else "halo"
+                                and pz == 1 and self.h <= 8) else "halo"
             logger.info(
                 "FiniteDifferencer(h=%d, proc_shape=%s): mode='auto' "
                 "selected the %s path on backend %s", self.h,
                 decomp.proc_shape, mode, jax.default_backend())
         if mode not in ("halo", "roll", "pallas"):
             raise ValueError(f"unknown mode {mode}")
-        if mode == "pallas" and (decomp.proc_shape[1] != 1
-                                 or decomp.proc_shape[2] != 1):
+        if mode == "pallas" and decomp.proc_shape[2] != 1:
             raise ValueError(
-                "pallas mode supports sharding only along x; use halo mode")
+                "pallas mode supports x/y sharding only (the z axis is "
+                "the VMEM lane dimension); use halo mode")
         self.mode = mode
         self._sharded_cache = {}
         self._pallas_infeasible = set()
@@ -378,8 +377,10 @@ class FiniteDifferencer:
         if cached is not None:
             return cached
 
-        px = self.decomp.proc_shape[0]
-        local_shape = (global_shape[0] // px,) + tuple(global_shape[1:])
+        px, py = self.decomp.proc_shape[:2]
+        # rank_shape validates divisibility (a non-divisible grid raises
+        # the ValueError _pallas_dispatch turns into the halo fallback)
+        local_shape = self.decomp.rank_shape(global_shape)
         n_out = n_comp // 3 if vector_in else n_comp
         out_defs = {"lap": {"lap": (n_out,)},
                     "grad": {"grad": (n_out, 3)},
@@ -390,9 +391,10 @@ class FiniteDifferencer:
         body = self._pallas_bodies(name, n_out)
         try:
             st = StreamingStencil(local_shape, {"f": n_comp}, self.h, body,
-                                  out_defs, dtype=dtype, x_halo=(px > 1))
+                                  out_defs, dtype=dtype,
+                                  x_halo=(px > 1), y_halo=(py > 1))
         except ValueError:
-            if px > 1:
+            if px > 1 or py > 1:
                 raise  # resident kernels assume local periodicity
             # streaming infeasible (Z below the 128-lane tile, or no
             # blocking): whole-lattice-resident kernel — all-roll taps,
@@ -400,12 +402,13 @@ class FiniteDifferencer:
             st = ResidentStencil(local_shape, {"f": n_comp}, self.h, body,
                                  out_defs, dtype=dtype)
 
-        if px > 1:
-            h = self.h
+        if px > 1 or py > 1:
+            from pystella_tpu.ops.pallas_stencil import sharded_halo
             decomp = self.decomp
+            halo = sharded_halo(self.h, px, py)
 
             def sharded_fn(x):
-                xpad = decomp.pad_with_halos(x, (h, 0, 0))
+                xpad = decomp.pad_with_halos(x, halo)
                 return tuple(st(xpad).values())
 
             import jax as _jax

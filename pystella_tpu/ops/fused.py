@@ -240,12 +240,9 @@ class FusedScalarStepper(_step.Stepper):
             return call
 
         import jax
-        from pystella_tpu.ops.pallas_stencil import HY
+        from pystella_tpu.ops.pallas_stencil import sharded_halo
         decomp = self.decomp
-        # x pads with the stencil radius; y pads with the 8-aligned HY
-        # window width (Mosaic-clean sublane offsets, see StreamingStencil)
-        halo = (self.h if self._px > 1 else 0,
-                HY if self._py > 1 else 0, 0)
+        halo = sharded_halo(self.h, self._px, self._py)
         out_names = list(st.out_defs) + list(st.sum_defs)
         scalar_names = st.scalar_names
         from jax.sharding import PartitionSpec as P

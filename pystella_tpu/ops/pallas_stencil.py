@@ -41,7 +41,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["StreamingStencil", "ResidentStencil", "Taps", "HY", "LANE",
-           "choose_blocks", "lap_from_taps", "grad_from_taps"]
+           "choose_blocks", "sharded_halo", "lap_from_taps",
+           "grad_from_taps"]
 
 #: aligned y-halo width (one sublane tile); must be >= the stencil radius
 HY = 8
@@ -56,6 +57,15 @@ HY = 8
 LANE = 128
 
 _RING = 4  # x-block ring slots: 3 live + 1 in flight
+
+
+def sharded_halo(h, px, py):
+    """Halo widths for ``pad_with_halos`` feeding x/y-sharded window
+    kernels: x pads with the stencil radius ``h``, but sharded y MUST
+    pad with the 8-aligned ``HY`` window width — an ``h``-wide y pad
+    would put the window DMAs on misaligned sublane offsets, which
+    Mosaic rejects (and interpret mode would read wrong halo rows)."""
+    return (h if px > 1 else 0, HY if py > 1 else 0, 0)
 
 
 def _is_cpu():

@@ -217,6 +217,29 @@ def test_finitedifferencer_pallas_sharded_x():
 
 
 @interpret_only
+@pytest.mark.parametrize("proc", [(1, 2, 1), (2, 2, 1)])
+def test_finitedifferencer_pallas_sharded_2d(proc):
+    """y- and xy-sharded lattices through the pallas y_halo path (the
+    fused steppers' 2-D window machinery, reused by the FD operators)."""
+    import jax
+    import pystella_tpu as ps
+
+    ndev = proc[0] * proc[1]
+    if len(jax.devices()) < ndev:
+        pytest.skip(f"needs {ndev} devices")
+    decomp = ps.DomainDecomposition(proc, devices=jax.devices()[:ndev])
+    fd = ps.FiniteDifferencer(decomp, 2, 0.3, mode="pallas")
+    rng = np.random.default_rng(2)
+    xh = rng.standard_normal((2, 16, 16, 16))
+    x = decomp.shard(xh)
+    out = np.asarray(fd.lap(x))
+    ref = _numpy_lap(xh, _lap_coefs[2], 0.3)
+    assert np.max(np.abs(out - ref)) / np.max(np.abs(ref)) < 1e-12
+    g = np.asarray(fd.grad(x))
+    assert g.shape == (2, 3, 16, 16, 16)
+
+
+@interpret_only
 @pytest.mark.parametrize("shape", [(16, 16, 16), (8, 24, 12),
                                    (32, 32, 64)])
 def test_resident_lap_matches_numpy(shape):
