@@ -121,3 +121,17 @@ if __name__ == "__main__":
     common.report("field histogram (lin+log)",
                   ps.timer(lambda: hister(fx), ntime=args.ntime),
                   nsites=nsites)
+
+
+def test_field_histogrammer_f32_degenerate_bounds(decomp):
+    """A constant f32 field with |value| above the dtype's exact-integer
+    range: the degeneracy widening must survive the cast into the bin
+    expressions' dtype (a +1.0 bump rounds away at 1e8 in f32, leaving
+    0/0 = nan bin indices — code-review regression, round 4)."""
+    fh = ps.FieldHistogrammer(decomp, 8, dtype=np.float64)
+    f = decomp.shard(np.full((8, 8, 8), 1e8, np.float32))
+    out = fh(f)
+    assert out["linear"].sum() == 512
+    assert out["linear"][0] == 512  # in bin 0 by value, not by nan cast
+    assert np.all(np.isfinite(out["linear_bins"]))
+    assert np.all(np.isfinite(out["log_bins"]))
