@@ -160,7 +160,7 @@ def _resolve_fused(fused, grid_shape=None):
 
 
 def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
-                       fused="auto", decomp=None):
+                       fused="auto", decomp=None, make_state=True):
     import jax
     import pystella_tpu as ps
 
@@ -203,6 +203,8 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
 
         stepper = ps.LowStorageRK54(full_rhs, dt=dt)
 
+    if not make_state:  # callers supplying their own initial state
+        return stepper, None, dt
     rng = np.random.default_rng(7)
     state = {
         "f": decomp.shard(
@@ -273,6 +275,58 @@ def run_preheat(n, nsteps=10, dtype=np.float32, fused="auto"):
 # ---------------------------------------------------------------------------
 # secondary config matrix (BASELINE.md "configs")
 # ---------------------------------------------------------------------------
+
+def run_coupled(n=512, nsteps=10, dtype=np.float32):
+    """The energy-coupled chunked SCIENCE driver: expansion ODE on
+    device with exact per-stage feedback from in-kernel energy sums
+    (single-stage kernels — the accuracy-preserving fast path, vs
+    multi_step's fixed-background stage pairs)."""
+    import jax
+    import pystella_tpu as ps
+
+    grid_shape = (n, n, n)
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+    stepper, _, dt = build_preheat_step(grid_shape, dtype, fused=True,
+                                        decomp=decomp, make_state=False)
+    if not hasattr(stepper, "coupled_multi_step"):
+        # build_preheat_step degraded to the generic stepper: no fused
+        # tier fits this lattice (needs Z % 128 == 0 or a
+        # resident-feasible size) — say so instead of AttributeError-ing
+        raise RuntimeError(
+            f"coupled-science config needs a fused stepper; none is "
+            f"feasible for {grid_shape}")
+    # physical near-homogeneous preheating ICs (the random-noise state
+    # the throughput configs use is violently unstable under the
+    # g^2 phi^2 chi^2 coupling and would drive the expansion to nan)
+    rng = np.random.default_rng(31)
+    f0, df0 = [0.193, 0.0], [-0.142231, 0.0]
+    state = {
+        "f": decomp.shard(np.stack(
+            [np.full(grid_shape, f0[i], dtype)
+             + 1e-4 * rng.standard_normal(grid_shape).astype(dtype)
+             for i in range(2)])),
+        "dfdt": decomp.shard(np.stack(
+            [np.full(grid_shape, df0[i], dtype)
+             + 1e-4 * rng.standard_normal(grid_shape).astype(dtype)
+             for i in range(2)])),
+    }
+    # rho of the homogeneous background in mphi units:
+    # kinetic 0.142231^2/2 + potential 0.193^2/2
+    expand = ps.Expansion(0.0287, ps.LowStorageRK54)
+
+    hb(f"coupled-{n}^3: compiling + warmup (one {nsteps}-step chunk)")
+    state = stepper.coupled_multi_step(state, nsteps, expand, 0.0, dt)
+    sync(state)
+    hb(f"coupled-{n}^3: timing one {nsteps}-step chunk")
+    start = time.perf_counter()
+    state = stepper.coupled_multi_step(state, nsteps, expand, 0.0, dt)
+    sync(state)
+    elapsed = time.perf_counter() - start
+    ups = float(n) ** 3 * nsteps / elapsed
+    hb(f"coupled-{n}^3: {elapsed / nsteps * 1e3:.2f} ms/step, "
+       f"{ups:.3e} site-updates/s (a={float(expand.a):.6f})")
+    return ups
+
 
 def run_wave(n=64, nsteps=50, nwarmup=5):
     """3-D wave equation, classical RK4 + 4th-order FD Laplacian."""
@@ -598,12 +652,17 @@ def payload(platform_wanted):
              lambda: run_multigrid(mg_n), "ms/V-cycle", None,
              2 * budget)]
         if platform == "tpu":
-            # compiled-only config (the 24-component pair kernel would
-            # run in interpret mode on CPU — pointlessly slow)
+            # compiled-only configs (fused kernels run interpret-mode on
+            # CPU — pointlessly slow)
             gw_n = int(os.environ.get("BENCH_GW_N", "256"))
             configs.insert(2, (
                 f"gw-step-{gw_n}^3", lambda: run_gw_step(gw_n),
                 "site-updates/s", 1e9, budget))
+            cp_n = int(os.environ.get("BENCH_COUPLED_N", "512"))
+            configs.insert(3, (
+                f"coupled-science-{cp_n}^3",
+                lambda: run_coupled(cp_n), "site-updates/s", 1e9,
+                budget))
         for label, fn, unit, base, cfg_budget in configs:
             try:
                 hb(f"extra config: {label}")
