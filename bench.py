@@ -78,10 +78,17 @@ def cache_load():
     """Most recent cached line per metric, in first-seen metric order."""
     if os.environ.get("BENCH_NO_CACHE", "0") == "1":
         return []
+    lines = []
     try:
         with open(CACHE_PATH) as f:
-            lines = [json.loads(ln) for ln in f if ln.strip()]
-    except (OSError, ValueError):
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    lines.append(json.loads(ln))
+                except ValueError:
+                    continue  # torn line from a killed run: skip it
+    except OSError:
         return []
     by_metric = {}
     for rec in lines:

@@ -1,0 +1,52 @@
+"""The bench orchestrator's hardware-line cache is what makes a round's
+bench record outage-proof (rounds 1 and 3 lost their records to tunnel
+outages) — pin its behavior."""
+
+import importlib
+import json
+import sys
+
+
+def _load_bench(tmp_path, monkeypatch):
+    root = __import__("os").path.dirname(__import__("os").path.dirname(
+        __import__("os").path.abspath(__file__)))
+    monkeypatch.syspath_prepend(root)
+    bench = importlib.import_module("bench")
+    monkeypatch.setattr(bench, "CACHE_PATH",
+                        str(tmp_path / "tpu_lines.jsonl"))
+    return bench
+
+
+def test_cache_roundtrip_latest_wins(tmp_path, monkeypatch):
+    bench = _load_bench(tmp_path, monkeypatch)
+    bench.cache_append({"metric": "m1", "value": 1.0, "unit": "u",
+                        "vs_baseline": 0.1})
+    bench.cache_append({"metric": "m2", "value": 5.0, "unit": "u",
+                        "vs_baseline": None})
+    bench.cache_append({"metric": "m1", "value": 2.0, "unit": "u",
+                        "vs_baseline": 0.2})
+    cached = bench.cache_load()
+    assert [r["metric"] for r in cached] == ["m1", "m2"]
+    assert cached[0]["value"] == 2.0  # later line supersedes
+    line = bench.cached_line(cached[0])
+    assert line["metric"].startswith("m1 [cached ")
+    assert line["value"] == 2.0 and line["vs_baseline"] == 0.2
+
+
+def test_cache_tolerates_missing_and_garbage(tmp_path, monkeypatch):
+    bench = _load_bench(tmp_path, monkeypatch)
+    assert bench.cache_load() == []  # no file
+    (tmp_path / "tpu_lines.jsonl").write_text(
+        'not json\n{"metric": "ok", "value": 1, "unit": "u"}\n'
+        '{"metric": "torn", "val')
+    # torn/garbage lines (a killed run) are skipped; intact lines load
+    cached = bench.cache_load()
+    assert [r["metric"] for r in cached] == ["ok"]
+
+
+def test_cache_disabled_by_env(tmp_path, monkeypatch):
+    bench = _load_bench(tmp_path, monkeypatch)
+    bench.cache_append({"metric": "m", "value": 1.0, "unit": "u",
+                        "vs_baseline": 1.0})
+    monkeypatch.setenv("BENCH_NO_CACHE", "1")
+    assert bench.cache_load() == []
