@@ -609,9 +609,13 @@ def run_multigrid(n=512, ncycles=2):
     rho = decomp.shard(rho_np - rho_np.mean())
     f = decomp.zeros(grid_shape, dtype)
 
+    t0 = time.perf_counter()
     _, sol = mg(decomp, dx0=dx, f=f, rho=rho)  # warm compile
     f = sol["f"]
     sync(f)
+    hb(f"multigrid-{n}^3: first V-cycle (compile + run) "
+       f"{time.perf_counter() - t0:.1f}s (round-3 baseline: ~365 s "
+       "of XLA compile at 512^3)")
     start = time.perf_counter()
     for _ in range(ncycles):
         _, sol = mg(decomp, dx0=dx, f=f, rho=rho)
