@@ -61,6 +61,14 @@ parser.add_argument("--chunk-mode", choices=("coupled", "frozen"),
                          "measured constraint drift ~3e-2 at 32^3/t=1/"
                          "N=4 vs 6e-8 exact; benchmark / fixed-"
                          "background use.")
+parser.add_argument("--chunk-pair", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="with --chunk-mode coupled: run the chunk "
+                         "through the deferred-drag stage-PAIR kernels "
+                         "(exact coupling at pair-fused HBM traffic). "
+                         "auto uses them when available; off forces "
+                         "single-stage kernels (one global energy "
+                         "barrier per stage).")
 parser.add_argument("--checkpoint-dir", type=str, default=None,
                     help="enable checkpoint/resume under this directory")
 parser.add_argument("--checkpoint-interval", type=int, default=100,
@@ -126,15 +134,20 @@ def main(argv=None):
         raise ValueError("--chunk-steps requires --fused (multi_step is "
                          "a fused-stepper driver)")
     if p.fused:
+        # donate=True: the driver loop never reuses a consumed state or
+        # carry, so per-stage donation halves eager peak HBM — the
+        # difference between GW at 448^3 fitting a single chip or not
+        # (doc/performance.md "Memory")
         if p.gravitational_waves:
             stepper = ps.FusedPreheatStepper(
                 scalar_sector, gw_sector, decomp, p.grid_shape,
                 lattice.dx, p.halo_shape, tableau=Stepper,
-                dtype=p.dtype, dt=dt)
+                dtype=p.dtype, dt=dt, donate=True)
         else:
             stepper = ps.FusedScalarStepper(
                 scalar_sector, decomp, p.grid_shape, lattice.dx,
-                p.halo_shape, tableau=Stepper, dtype=p.dtype, dt=dt)
+                p.halo_shape, tableau=Stepper, dtype=p.dtype, dt=dt,
+                donate=True)
     else:
         stepper = Stepper(full_rhs, dt=dt)
 
@@ -279,8 +292,11 @@ def main(argv=None):
                 if p.chunk_mode == "coupled":
                     # expansion ODE integrated on device, exact
                     # per-stage energy feedback (in-kernel reductions)
+                    pair = {"auto": None, "on": True,
+                            "off": False}[p.chunk_pair]
                     state = stepper.coupled_multi_step(
-                        state, n, expand, t, dt, grid_size=p.grid_size)
+                        state, n, expand, t, dt, grid_size=p.grid_size,
+                        pair=pair)
                 else:
                     # frozen-rho: host-precomputed background (see
                     # --chunk-mode help for the accuracy price)

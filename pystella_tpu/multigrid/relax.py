@@ -293,8 +293,9 @@ class RelaxationBase:
                       **dict(zip(aux_lat, aux_args[:len(aux_lat)]))}
 
             def one(fst):
-                fin = (decomp.pad_with_halos(fst, halo) if sharded
-                       else fst)
+                fin = (decomp.pad_with_halos(
+                    fst, halo, exchange=(self.halo_shape,) * 3)
+                    if sharded else fst)
                 return st(fin, scalars=scalars, extras=extras)["out"]
 
             if kind != "smooth":

@@ -408,7 +408,8 @@ class FiniteDifferencer:
             halo = sharded_halo(self.h, px, py)
 
             def sharded_fn(x):
-                xpad = decomp.pad_with_halos(x, halo)
+                xpad = decomp.pad_with_halos(x, halo,
+                                             exchange=(self.h,) * 3)
                 return tuple(st(xpad).values())
 
             import jax as _jax

@@ -84,7 +84,8 @@ class FusedScalarStepper(_step.Stepper):
     def __init__(self, sector, decomp, grid_shape, dx, halo_shape=2,
                  tableau=None, dtype=jnp.float32, bx=None, by=None,
                  dt=None, pair_stages=True, pair_bx=None, pair_by=None,
-                 interpret=None, donate=False, resident=None, **kwargs):
+                 interpret=None, donate=False, resident=None,
+                 carry_dtype=None, **kwargs):
         tableau = tableau or _step.LowStorageRK54
         self._A = tableau._A
         self._B = tableau._B
@@ -99,7 +100,9 @@ class FusedScalarStepper(_step.Stepper):
                 "fused steppers support x/y sharding (proc_shape "
                 "(px, py, 1)); the z axis is the VMEM lane dimension "
                 "(kept whole per device) — use the generic LowStorageRK "
-                "steppers with FiniteDifferencer for z-sharded meshes")
+                "steppers with FiniteDifferencer for z-sharded meshes "
+                "(pystella_tpu.advise_shapes lists which meshes keep "
+                "the fused tier available)")
         self._px = decomp.proc_shape[0]
         self._py = decomp.proc_shape[1]
         self.grid_shape = tuple(grid_shape)
@@ -122,6 +125,18 @@ class FusedScalarStepper(_step.Stepper):
         self._pair_call = None  # set by _build_kernels when pairing
         self._interpret = interpret
         self._resident = resident
+        self._donate = bool(donate)
+        # mixed-precision RK carries (e.g. jnp.bfloat16): the 2N-storage
+        # k arrays are STORED at reduced precision while all in-kernel
+        # arithmetic stays in ``dtype`` (taps promote; outputs cast on
+        # write). Halves the carry half of the state footprint — the
+        # difference between the 512**3 GW system fitting one chip
+        # (~12.4 GB vs 16.5 GB f32, doc/performance.md "Memory") — at a
+        # measured accuracy cost bounded by the carry quantization
+        # (tests/test_fused.py::test_bf16_carry_accuracy; NOT for
+        # convergence-order-critical runs).
+        self._carry_dtype = (None if carry_dtype is None
+                             else jnp.zeros((), carry_dtype).dtype)
         self._build_kernels(bx, by)
 
         # jitted whole-step (one XLA computation, all stages fused).
@@ -132,8 +147,10 @@ class FusedScalarStepper(_step.Stepper):
         self._jit_step = jax.jit(
             self._step_impl, donate_argnums=(0,) if donate else ())
         self._jit_multi = {}  # (nsteps, seq struct) -> jitted multi_step
-        self._jit_coupled = {}  # (nsteps, grid_size, mpl) -> jitted
+        self._jit_coupled = {}  # (nsteps, grid_size, mpl, pair) -> jitted
         self._es_call = None  # lazily built energy-emitting stage kernel
+        self._pes_call = None  # lazily built energy-emitting pair kernel
+        self._pes_tried = False
 
     @property
     def _halo_kw(self):
@@ -142,6 +159,10 @@ class FusedScalarStepper(_step.Stepper):
         return {"x_halo": self._px > 1, "y_halo": self._py > 1,
                 "interpret": self._interpret}
 
+    #: array names that hold 2N-storage RK carries (reduced-precision
+    #: storage candidates; subclasses extend)
+    _carry_names = frozenset({"kf", "kdfdt", "kdfp"})
+
     def _build_stencil(self, win_defs, body, out_defs, extra_defs,
                        scalar_names, bx=None, by=None, sum_defs=None):
         """A stage kernel: streaming VMEM-ring windows when the lattice
@@ -149,8 +170,13 @@ class FusedScalarStepper(_step.Stepper):
         all-roll kernel — the Z < 128 small-lattice tier (VERDICT r3
         #4). ``resident=True``/``False`` at construction forces the
         choice."""
+        dtypes = None
+        if self._carry_dtype is not None:
+            names = (set(win_defs) | set(extra_defs or {})
+                     | set(out_defs)) & self._carry_names
+            dtypes = {n: self._carry_dtype for n in names}
         common = dict(extra_defs=extra_defs, scalar_names=scalar_names,
-                      dtype=self.dtype, sum_defs=sum_defs)
+                      dtype=self.dtype, sum_defs=sum_defs, dtypes=dtypes)
         if not self._resident:
             try:
                 return StreamingStencil(
@@ -231,13 +257,24 @@ class FusedScalarStepper(_step.Stepper):
     def _make_call(self, st, windows, extra_names):
         """Wrap a StreamingStencil in a ``shard_map`` over the sharded
         mesh axes (padding the windowed inputs with ``ppermute`` halos)
-        or call it directly on an unsharded lattice."""
+        or call it directly on an unsharded lattice.
+
+        With ``donate=True`` (construction) the per-stage calls donate
+        their lattice inputs — every stage fully replaces its state and
+        carry, so eager per-stage driving (the default
+        ``examples/scalar_preheating.py`` loop) runs at ~one-state peak
+        HBM instead of two (VERDICT r4 #7). Inside ``jit``-traced chunk
+        drivers the inner donation is inlined away and the outer jit's
+        own donation governs."""
         if self._px == 1 and self._py == 1:
             def call(win_arrays, scalars, extras):
                 arg = (win_arrays[windows[0]] if len(windows) == 1
                        else win_arrays)
                 return st(arg, scalars=scalars, extras=extras)
-            return call
+            if not self._donate:
+                return call
+            import jax
+            return jax.jit(call, donate_argnums=(0, 2))
 
         import jax
         from pystella_tpu.ops.pallas_stencil import sharded_halo
@@ -249,7 +286,8 @@ class FusedScalarStepper(_step.Stepper):
 
         def body(*flat):
             nw = len(windows)
-            wins = {n: decomp.pad_with_halos(a, halo)
+            wins = {n: decomp.pad_with_halos(a, halo,
+                                             exchange=(self.h,) * 3)
                     for n, a in zip(windows, flat[:nw])}
             ns = len(scalar_names)
             scalars = dict(zip(scalar_names, flat[nw:nw + ns]))
@@ -265,8 +303,13 @@ class FusedScalarStepper(_step.Stepper):
                     + (lat_spec,) * len(extra_names))
         out_specs = (tuple(decomp.spec(1) for _ in st.out_defs)
                      + (P(),) * len(st.sum_defs))
+        nw, ns = len(windows), len(scalar_names)
+        donate = (tuple(range(nw))
+                  + tuple(range(nw + ns, nw + ns + len(extra_names)))
+                  if self._donate else ())
         sharded = jax.jit(decomp.shard_map(
-            body, in_specs, out_specs, check_vma=False))
+            body, in_specs, out_specs, check_vma=False),
+            donate_argnums=donate)
 
         def call(win_arrays, scalars, extras):
             flat = ([win_arrays[n] for n in windows]
@@ -357,7 +400,9 @@ class FusedScalarStepper(_step.Stepper):
     def _scalar_pair_core(self, taps, extras, scalars):
         """Two consecutive 2N-storage scalar stages in one HBM pass;
         returns the four outputs plus the stage-1 field's composed taps
-        (for subclasses that differentiate the intermediate field)."""
+        (for subclasses that differentiate the intermediate field).
+        (The energy-coupled pair variant lives in
+        :meth:`_deferred_pair_core`.)"""
         tf, tdf, tkf = taps["f"], taps["dfdt"], taps["kf"]
         kdf0 = extras["kdfdt"]
         inv_dx2 = [1.0 / d**2 for d in self.dx]
@@ -398,7 +443,10 @@ class FusedScalarStepper(_step.Stepper):
 
     def init_carry(self, state):
         import jax
-        k = jax.tree_util.tree_map(jnp.zeros_like, state)
+        cd = self._carry_dtype
+        k = jax.tree_util.tree_map(
+            jnp.zeros_like if cd is None
+            else (lambda x: jnp.zeros_like(x, dtype=cd)), state)
         return (state, k)
 
     def extract(self, carry):
@@ -602,7 +650,203 @@ class FusedScalarStepper(_step.Stepper):
         dt = dt if dt is not None else self.dt
         return self._jit_step(state, t, dt, rhs_args or {})
 
+    # -- deferred-drag coupled pair kernels --------------------------------
+    #
+    # The energy-coupled stage-pair problem: the pair kernel needs the
+    # second stage's expansion scalars at launch, but the exact
+    # ``hubble2`` only exists after the first stage's global energy
+    # reduction. The resolution is that ``hubble2`` enters the stage-2
+    # update LINEARLY and ONLY through the Hubble-drag term (``a2``
+    # never depends on rho at all: ``ka = A ka + dt adot; a += B ka``),
+    # so the kernel can DEFER that one term: it outputs the stage-1
+    # velocity ``df1`` and the drag-free stage-2 carry ``kdfp = A2 kdf1
+    # + dt (lap f1 - a2^2 dV(f1))`` instead of the completed
+    # ``(dfdt, kdfdt)``. The NEXT pair kernel — which by then holds the
+    # exact ``hubble2`` (integrated between kernels from the TRUE
+    # in-kernel energy sums) — completes ``kdf2 = kdfp - 2 dt hub2 df1;
+    # df2 = df1 + B2 kdf2`` in-register while reconstructing its taps,
+    # and the chunk end applies the same completion as one fused
+    # elementwise op. Net: the pair-fused hot loop's HBM traffic with
+    # EXACT per-stage Friedmann coupling (driver-loop parity to float
+    # roundoff) — no predictor, no stale background anywhere.
+    #
+    # The deferral requires the potential (and, for the GW system, the
+    # anisotropic stress) to not reference ``hubble`` symbolically —
+    # checked at build time (:meth:`_hubble_free`); otherwise the
+    # coupled chunk falls back to single-stage kernels.
+
+    @property
+    def _hubble_free(self):
+        """True when the stage-2 non-drag terms are hubble-independent
+        (the deferred-drag factorization's soundness condition)."""
+        exprs = [self._V] + list(self._dvdf)
+        return all("hubble" not in _field.field_names(e) for e in exprs)
+
+    def _def_win_defs(self, in_deferred):
+        F = self.F
+        if in_deferred:
+            return {"f": F, "dfp": F, "kdfp": F, "kf": F}, {}
+        return {"f": F, "dfdt": F, "kf": F}, {"kdfdt": (F,)}
+
+    def _def_out_defs(self):
+        F = self.F
+        return {"f": (F,), "dfp": (F,), "kf": (F,), "kdfp": (F,)}
+
+    def _def_in_normal(self, carry):
+        state, k = carry
+        return ({"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"]},
+                {"kdfdt": k["dfdt"]})
+
+    def _def_in_deferred(self, carry):
+        state, k = carry
+        return ({"f": state["f"], "dfp": state["dfdt"],
+                 "kdfp": k["dfdt"], "kf": k["f"]}, {})
+
+    def _def_out(self, outs):
+        return ({"f": outs["f"], "dfdt": outs["dfp"]},
+                {"f": outs["kf"], "dfdt": outs["kdfp"]})
+
+    def _finalize_deferred(self, carry, dt, hubfix, B2p):
+        """Complete the deferred stage-2 Hubble drag of a chunk's final
+        pair with the (by now exact) ``hubfix``: one fused elementwise
+        pass, the same arithmetic the next kernel would have applied."""
+        state, k = carry
+        kdf = k["dfdt"] - 2 * dt * hubfix * state["dfdt"]
+        df = state["dfdt"] + B2p * kdf
+        return ({"f": state["f"], "dfdt": df}, {"f": k["f"], "dfdt": kdf})
+
+    @staticmethod
+    def _completed_taps(tdfp, tkdfp, dt, hubfix, B2p):
+        """Taps-like view of the previous pair's completed velocity
+        ``df = dfp + B2p (kdfp - 2 dt hubfix dfp)``, composed in-register
+        from the deferred windows (memoized per offset)."""
+        cache = {}
+
+        def taps(sx=0, sy=0, sz=0):
+            key = (sx, sy, sz)
+            if key not in cache:
+                dfp = tdfp(sx, sy, sz)
+                cache[key] = dfp + B2p * (tkdfp(sx, sy, sz)
+                                          - 2 * dt * hubfix * dfp)
+            return cache[key]
+        return taps
+
+    def _deferred_pair_core(self, taps, extras, scalars, in_deferred):
+        """Scalar-system core of the deferred-drag coupled pair: the
+        stage-pair arithmetic of :meth:`_scalar_pair_core` with (a) the
+        incoming state optionally reconstructed from the previous pair's
+        deferred representation and (b) the outgoing stage-2 drag
+        deferred. Returns ``(outs, f1_taps, df1)`` for the GW subclass."""
+        tf, tkf = taps["f"], taps["kf"]
+        inv_dx2 = [1.0 / d**2 for d in self.dx]
+        coefs = _lap_coefs[self.h]
+        dt = scalars["dt"]
+        a1, hub1 = scalars["a1"], scalars["hubble1"]
+        A1, B1 = scalars["A1"], scalars["B1"]
+        a2 = scalars["a2"]
+        A2, B2 = scalars["A2"], scalars["B2"]
+
+        if in_deferred:
+            tdf = self._completed_taps(taps["dfp"], taps["kdfp"], dt,
+                                       scalars["hubfix"], scalars["B2p"])
+            kdf0 = (taps["kdfp"]() - 2 * dt * scalars["hubfix"]
+                    * taps["dfp"]())
+        else:
+            tdf = taps["dfdt"]
+            kdf0 = extras["kdfdt"]
+
+        # stage 1 (identical arithmetic to _scalar_body, exact scalars)
+        f0, df0 = tf(), tdf()
+        lap_f = _lap_from_taps(tf, coefs, inv_dx2)
+        kf1 = A1 * tkf() + dt * df0
+        f1 = f0 + B1 * kf1
+        kdf1 = A1 * kdf0 + dt * (lap_f - 2 * hub1 * df0
+                                 - a1 * a1 * self._dV(f0, a1, hub1))
+        df1 = df0 + B1 * kdf1
+
+        f1_taps = self._axpy_taps(tf, tkf, tdf, B1, A1, dt, f1)
+        lap_f1 = _lap_from_taps(f1_taps, coefs, inv_dx2)
+
+        # stage 2: everything but the Hubble drag (deferred; a2 is
+        # exact — its update never touches rho). dV/V evaluate with
+        # hubble=None: the _hubble_free gate guarantees no lookup.
+        kf2 = A2 * kf1 + dt * df1
+        f2 = f1 + B2 * kf2
+        kdfp = A2 * kdf1 + dt * (lap_f1 - a2 * a2 * self._dV(f1, a2, None))
+        outs = {"f": f2, "dfp": df1, "kf": kf2, "kdfp": kdfp,
+                "esums1": self._esums(f0, df0, lap_f, a1, hub1),
+                "esums2": self._esums(f1, df1, lap_f1, a2, None)}
+        return outs, f1_taps, df1
+
+    def _deferred_body(self, taps, extras, scalars, in_deferred):
+        outs, _, _ = self._deferred_pair_core(taps, extras, scalars,
+                                              in_deferred)
+        return outs
+
+    def _build_coupled_pair_call(self, in_deferred):
+        F = self.F
+        win_defs, extra_defs = self._def_win_defs(in_deferred)
+        scalar_names = ("dt", "a1", "hubble1", "A1", "B1", "a2",
+                        "A2", "B2")
+        if in_deferred:
+            scalar_names += ("hubfix", "B2p")
+        st = self._build_stencil(
+            win_defs,
+            lambda t, e, s: self._deferred_body(t, e, s, in_deferred),
+            self._def_out_defs(), extra_defs, scalar_names,
+            sum_defs={"esums1": 2 * F + 1, "esums2": 2 * F + 1})
+        return self._make_call(st, windows=tuple(win_defs),
+                               extra_names=tuple(extra_defs))
+
+    def _ensure_coupled_pair_calls(self):
+        """Build (lazily) the two deferred-drag coupled pair kernels
+        (normal-repr input for a chunk's first pair, deferred-repr input
+        for the rest). Returns None — and coupled chunks degrade to
+        single-stage kernels — when pairing is disabled, the tableau's
+        ``A[0] != 0`` (the cross-boundary k-carry reset would not be a
+        no-op), the potential references ``hubble``, or no blocking of
+        the wider deferred windows fits VMEM."""
+        if self._pes_tried:
+            return self._pes_call
+        self._pes_tried = True
+        if (not self._pair_stages or self._A[0] != 0
+                or not self._hubble_free):
+            return None
+        try:
+            self._pes_call = (self._build_coupled_pair_call(False),
+                              self._build_coupled_pair_call(True))
+        except ValueError as e:
+            import warnings
+            warnings.warn(
+                f"deferred-drag coupled pair kernels unavailable ({e}); "
+                "coupled_multi_step will run single-stage kernels",
+                stacklevel=3)
+            self._pes_call = None
+        return self._pes_call
+
     # -- energy-coupled chunk driver ---------------------------------------
+
+    def _combine_esums(self, es, a, grid_size):
+        """Raw kernel-emitted energy sums -> (rho, p) with the CURRENT
+        scale factor — the arithmetic of
+        :func:`~pystella_tpu.models.sectors.get_rho_and_p` on the
+        driver loop's per-stage ``compute_energy`` output."""
+        F = self.F
+        es = es.astype(a.dtype)
+        inv = 1.0 / (2.0 * a * a * grid_size)
+        kin = jnp.sum(es[:F]) * inv
+        grad = jnp.sum(es[F:2 * F]) * inv
+        pot = es[2 * F] / grid_size
+        return kin + grad + pot, kin - grad / 3.0 - pot
+
+    def _friedmann_stage(self, s, a, adot, ka, kadot, rho, p, dt, mpl):
+        """One 2N-storage expansion-ODE stage on traced scalars (the
+        arithmetic of :meth:`~pystella_tpu.Expansion.step`,
+        reference expansion.py:101-157)."""
+        addot = 4 * np.pi * a**3 / 3 / mpl**2 * (rho - 3 * p)
+        ka = self._A[s] * ka + dt * adot
+        kadot = self._A[s] * kadot + dt * addot
+        return a + self._B[s] * ka, adot + self._B[s] * kadot, ka, kadot
 
     def _coupled_impl(self, state, t, dt, a, adot, nsteps, grid_size,
                       mpl):
@@ -624,38 +868,115 @@ class FusedScalarStepper(_step.Stepper):
                 carry, esums = self._stage_energy(
                     s, carry, t, dt, {"a": a, "hubble": hubble})
                 # combine sums -> (rho, p) with the CURRENT a (matching
-                # compute_energy(..., expand.a) in the driver loop)
-                es = esums.astype(a.dtype)
-                F = self.F
-                inv = 1.0 / (2.0 * a * a * grid_size)
-                kin = jnp.sum(es[:F]) * inv
-                grad = jnp.sum(es[F:2 * F]) * inv
-                pot = es[2 * F] / grid_size
-                rho = kin + grad + pot
-                p = kin - grad / 3.0 - pot
+                # compute_energy(..., expand.a) in the driver loop), then
                 # expansion stage s (k = A k + dt rhs; y += B k)
-                addot = (4 * np.pi * a**3 / 3 / mpl**2 * (rho - 3 * p))
-                ka = self._A[s] * ka + dt * adot
-                kadot = self._A[s] * kadot + dt * addot
-                a = a + self._B[s] * ka
-                adot = adot + self._B[s] * kadot
+                rho, p = self._combine_esums(esums, a, grid_size)
+                a, adot, ka, kadot = self._friedmann_stage(
+                    s, a, adot, ka, kadot, rho, p, dt, mpl)
+        return self.extract(carry), a, adot
+
+    def _coupled_pair_impl(self, state, t, dt, a, adot, nsteps,
+                           grid_size, mpl):
+        """The pair-fused energy-coupled chunk, EXACT via deferred
+        drag: each stage-pair kernel runs with exact scalars for its
+        first stage (and the rho-independent ``a2``), defers the second
+        stage's Hubble-drag term, and emits the TRUE energy sums of both
+        stages' entry states; the Friedmann ODE advances on traced
+        scalars between kernels from those sums, producing the exact
+        ``hubble2`` that the NEXT kernel (or the chunk-end finalize)
+        uses to complete the deferred update. Reproduces the per-stage
+        driver loop to float roundoff — same arithmetic sequence up to
+        re-association of one ``dt`` distribution — at the pair-fused
+        hot loop's HBM traffic. Pairs cross step boundaries like
+        :meth:`multi_step` (gated on ``A[0] == 0``); an odd trailing
+        stage finalizes and runs the single-stage energy kernel."""
+        calls = self._ensure_coupled_pair_calls()
+        assert calls is not None  # coupled_multi_step gates on this
+        call_normal, call_deferred = calls
+        carry = self.init_carry(state)
+        ka = kadot = jnp.zeros_like(a)
+        ns = self.num_stages
+        flat = [s for _ in range(nsteps) for s in range(ns)]
+        deferred = False
+        hubfix = None  # exact hub completing the pending deferred stage
+        B2p = 0.0      # that stage's tableau B
+
+        i = 0
+        while i < len(flat):
+            s = flat[i]
+            if s == 0:
+                ka = kadot = jnp.zeros_like(a)
+            hub = adot / a
+            if i + 1 >= len(flat):
+                # odd trailing stage: complete the pending deferred
+                # drag, then one exact single-stage energy kernel
+                if deferred:
+                    carry = self._finalize_deferred(carry, dt, hubfix,
+                                                    B2p)
+                    deferred = False
+                carry, es = self._stage_energy(
+                    s, carry, t, dt, {"a": a, "hubble": hub})
+                rho, p = self._combine_esums(es, a, grid_size)
+                a, adot, ka, kadot = self._friedmann_stage(
+                    s, a, adot, ka, kadot, rho, p, dt, mpl)
+                i += 1
+                continue
+            s2 = flat[i + 1]
+            # a2 never touches rho: compute it exactly at launch (the
+            # identical fma sequence as the post-kernel Friedmann
+            # stage, so the two agree bitwise)
+            a2 = a + self._B[s] * (self._A[s] * ka + dt * adot)
+            scalars = {"dt": dt, "a1": a, "hubble1": hub, "a2": a2,
+                       "A1": self._A[s], "B1": self._B[s],
+                       "A2": self._A[s2], "B2": self._B[s2]}
+            if deferred:
+                scalars["hubfix"] = hubfix
+                scalars["B2p"] = B2p
+                wins, extras = self._def_in_deferred(carry)
+                outs = call_deferred(wins, scalars, extras)
+            else:
+                wins, extras = self._def_in_normal(carry)
+                outs = call_normal(wins, scalars, extras)
+            carry = self._def_out(outs)
+            deferred = True
+            # exact background integration from the true esums
+            rho, p = self._combine_esums(outs["esums1"], a, grid_size)
+            a, adot, ka, kadot = self._friedmann_stage(
+                s, a, adot, ka, kadot, rho, p, dt, mpl)
+            if s2 == 0:
+                ka = kadot = jnp.zeros_like(a)
+            hubfix = adot / a  # exact hub entering stage s2
+            B2p = self._B[s2]
+            rho2, p2 = self._combine_esums(outs["esums2"], a, grid_size)
+            a, adot, ka, kadot = self._friedmann_stage(
+                s2, a, adot, ka, kadot, rho2, p2, dt, mpl)
+            i += 2
+        if deferred:
+            carry = self._finalize_deferred(carry, dt, hubfix, B2p)
         return self.extract(carry), a, adot
 
     def coupled_multi_step(self, state, nsteps, expansion, t=0.0,
-                           dt=None, grid_size=None):
+                           dt=None, grid_size=None, pair=None):
         """Advance ``nsteps`` steps as ONE jitted computation with the
         scale factor evolved self-consistently on device — the accurate
         fast path for expanding-background runs (``--chunk-steps`` with
         the default coupled mode in ``examples/scalar_preheating.py``).
 
-        Exact per-stage coupling needs each stage's global energy
-        reduction before the next stage's scalars exist, so this path
-        runs single-stage kernels (a global barrier per stage); the
-        stage-pair fusion of :meth:`multi_step` remains the
-        fixed-background bench path. ``expansion`` (an
-        :class:`~pystella_tpu.Expansion`) provides the entry ``(a,
-        adot)`` and is ADVANCED to the chunk end. The input ``state``
-        buffers are donated."""
+        By default (``pair=None``) the chunk runs deferred-drag
+        stage-PAIR kernels: the pair-fused hot loop's HBM traffic (the
+        :meth:`multi_step` bench path) with EXACT per-stage Friedmann
+        feedback — each kernel emits both stages' true entry-state
+        energy sums and defers only the second stage's (linear)
+        Hubble-drag term until its exact ``hubble`` exists (see
+        :meth:`_coupled_pair_impl`; driver-loop parity to roundoff,
+        tests/test_fused.py::test_coupled_pair_accuracy_vs_driver).
+        ``pair=False`` forces the single-stage kernels (a global energy
+        barrier per stage); ``pair=True`` requires the pair path and
+        raises when it is unavailable (pairing disabled, ``A[0] != 0``,
+        a ``hubble``-referencing potential, or no feasible blocking).
+        ``expansion`` (an :class:`~pystella_tpu.Expansion`) provides the
+        entry ``(a, adot)`` and is ADVANCED to the chunk end. The input
+        ``state`` buffers are donated."""
         import functools
         import jax
         dt = dt if dt is not None else self.dt
@@ -663,12 +984,21 @@ class FusedScalarStepper(_step.Stepper):
         if grid_size is None:
             grid_size = float(np.prod(self.grid_shape))
         mpl = float(expansion.mpl)
-        self._ensure_energy_call()
-        key = (nsteps, grid_size, mpl)
+        if pair is None:
+            pair = self._ensure_coupled_pair_calls() is not None
+        elif pair and self._ensure_coupled_pair_calls() is None:
+            raise RuntimeError(
+                "pair=True but the deferred-drag coupled pair kernels "
+                "are unavailable on this stepper (pair_stages=False, "
+                "A[0] != 0, a hubble-referencing potential, or no "
+                "feasible blocking)")
+        self._ensure_energy_call()  # pair path's odd-tail stage uses it
+        key = (nsteps, grid_size, mpl, bool(pair))
         fn = self._jit_coupled.get(key)
         if fn is None:
+            impl = self._coupled_pair_impl if pair else self._coupled_impl
             fn = jax.jit(functools.partial(
-                self._coupled_impl, nsteps=nsteps, grid_size=grid_size,
+                impl, nsteps=nsteps, grid_size=grid_size,
                 mpl=mpl), donate_argnums=0)
             self._jit_coupled[key] = fn
         state, a, adot = fn(state, t=t, dt=dt,
@@ -694,6 +1024,9 @@ class FusedPreheatStepper(FusedScalarStepper):
 
     :arg gw_sector: a :class:`~pystella_tpu.TensorPerturbationSector`.
     """
+
+    _carry_names = frozenset({"kf", "kdfdt", "kdfp",
+                              "khij", "kdhijdt", "kdhp"})
 
     def __init__(self, sector, gw_sector, decomp, grid_shape, dx,
                  halo_shape=2, tableau=None, dtype=jnp.float32,
@@ -868,6 +1201,99 @@ class FusedPreheatStepper(FusedScalarStepper):
         new_k = {"f": outs["kf"], "dfdt": outs["kdfdt"],
                  "hij": outs["khij"], "dhijdt": outs["kdhijdt"]}
         return (new_state, new_k)
+
+    # -- deferred-drag coupled pair (scalar+GW) ----------------------------
+
+    @property
+    def _hubble_free(self):
+        exprs = ([self._V] + list(self._dvdf)
+                 + [self._sij[c] for c in range(self.n_hij)])
+        return all("hubble" not in _field.field_names(e) for e in exprs)
+
+    def _def_win_defs(self, in_deferred):
+        F, H = self.F, self.n_hij
+        if in_deferred:
+            return ({"f": F, "dfp": F, "kdfp": F, "kf": F,
+                     "hij": H, "dhp": H, "kdhp": H, "khij": H}, {})
+        return ({"f": F, "dfdt": F, "kf": F,
+                 "hij": H, "dhijdt": H, "khij": H},
+                {"kdfdt": (F,), "kdhijdt": (H,)})
+
+    def _def_out_defs(self):
+        F, H = self.F, self.n_hij
+        return {"f": (F,), "dfp": (F,), "kf": (F,), "kdfp": (F,),
+                "hij": (H,), "dhp": (H,), "khij": (H,), "kdhp": (H,)}
+
+    def _def_in_normal(self, carry):
+        state, k = carry
+        return ({"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"],
+                 "hij": state["hij"], "dhijdt": state["dhijdt"],
+                 "khij": k["hij"]},
+                {"kdfdt": k["dfdt"], "kdhijdt": k["dhijdt"]})
+
+    def _def_in_deferred(self, carry):
+        state, k = carry
+        return ({"f": state["f"], "dfp": state["dfdt"],
+                 "kdfp": k["dfdt"], "kf": k["f"],
+                 "hij": state["hij"], "dhp": state["dhijdt"],
+                 "kdhp": k["dhijdt"], "khij": k["hij"]}, {})
+
+    def _def_out(self, outs):
+        return ({"f": outs["f"], "dfdt": outs["dfp"],
+                 "hij": outs["hij"], "dhijdt": outs["dhp"]},
+                {"f": outs["kf"], "dfdt": outs["kdfp"],
+                 "hij": outs["khij"], "dhijdt": outs["kdhp"]})
+
+    def _finalize_deferred(self, carry, dt, hubfix, B2p):
+        state, k = carry
+        kdf = k["dfdt"] - 2 * dt * hubfix * state["dfdt"]
+        kdh = k["dhijdt"] - 2 * dt * hubfix * state["dhijdt"]
+        return ({"f": state["f"], "dfdt": state["dfdt"] + B2p * kdf,
+                 "hij": state["hij"],
+                 "dhijdt": state["dhijdt"] + B2p * kdh},
+                {"f": k["f"], "dfdt": kdf,
+                 "hij": k["hij"], "dhijdt": kdh})
+
+    def _deferred_body(self, taps, extras, scalars, in_deferred):
+        souts, f1_taps, _ = self._deferred_pair_core(
+            taps, extras, scalars, in_deferred)
+
+        th, tkh = taps["hij"], taps["khij"]
+        inv_dx2 = [1.0 / d**2 for d in self.dx]
+        lap_coefs = _lap_coefs[self.h]
+        dt = scalars["dt"]
+        a1, hub1 = scalars["a1"], scalars["hubble1"]
+        A1, B1 = scalars["A1"], scalars["B1"]
+        a2 = scalars["a2"]
+        A2, B2 = scalars["A2"], scalars["B2"]
+
+        if in_deferred:
+            tdh = self._completed_taps(taps["dhp"], taps["kdhp"], dt,
+                                       scalars["hubfix"], scalars["B2p"])
+            kdh0 = (taps["kdhp"]() - 2 * dt * scalars["hubfix"]
+                    * taps["dhp"]())
+        else:
+            tdh = taps["dhijdt"]
+            kdh0 = extras["kdhijdt"]
+
+        # tensor stage 1 (exact scalars; identical arithmetic to
+        # _preheat_body)
+        h0, dh0 = th(), tdh()
+        lap_h = _lap_from_taps(th, lap_coefs, inv_dx2)
+        sij1 = self._sij_eval(taps["f"], a1, hub1, h0.dtype, h0.shape[1:])
+        h1, dh1, kh1, kdh1 = self._gw_stage(
+            h0, dh0, tkh(), kdh0, lap_h, sij1, A1, B1, dt, hub1)
+
+        h1_taps = self._axpy_taps(th, tkh, tdh, B1, A1, dt, h1)
+        lap_h1 = _lap_from_taps(h1_taps, lap_coefs, inv_dx2)
+        sij2 = self._sij_eval(f1_taps, a2, None, h0.dtype, h0.shape[1:])
+
+        # tensor stage 2 with the Hubble drag deferred
+        kh2 = A2 * kh1 + dt * dh1
+        h2 = h1 + B2 * kh2
+        kdhp = A2 * kdh1 + dt * (lap_h1 + 16 * np.pi * sij2)
+        return {**souts, "hij": h2, "dhp": dh1, "khij": kh2,
+                "kdhp": kdhp}
 
     def _ensure_energy_call(self):
         if self._es_call is None:

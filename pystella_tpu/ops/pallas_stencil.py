@@ -64,7 +64,12 @@ def sharded_halo(h, px, py):
     kernels: x pads with the stencil radius ``h``, but sharded y MUST
     pad with the 8-aligned ``HY`` window width — an ``h``-wide y pad
     would put the window DMAs on misaligned sublane offsets, which
-    Mosaic rejects (and interpret mode would read wrong halo rows)."""
+    Mosaic rejects (and interpret mode would read wrong halo rows).
+    Callers pass ``exchange=(h, h, 0)`` alongside so only the ``h``
+    semantically-read rows ride the interconnect; the ``HY - h``
+    alignment rows are local zeros (the stencil taps reach at most
+    ``h``, so they are never read — ICI bytes drop 4x for h=2 while
+    the buffer layout stays Mosaic-clean)."""
     return (h if px > 1 else 0, HY if py > 1 else 0, 0)
 
 
@@ -254,7 +259,8 @@ class ResidentStencil:
 
     def __init__(self, lattice_shape, win_defs, h, body, out_defs,
                  extra_defs=None, scalar_names=(), dtype=jnp.float32,
-                 interpret=None, sum_defs=None, budget=32 * 2**20):
+                 interpret=None, sum_defs=None, budget=64 * 2**20,
+                 dtypes=None):
         self.lattice_shape = X, Y, Z = tuple(int(s) for s in lattice_shape)
         if not isinstance(win_defs, dict):
             win_defs = {"f": int(win_defs)}
@@ -268,6 +274,8 @@ class ResidentStencil:
                            for k, v in dict(extra_defs or {}).items()}
         self.scalar_names = tuple(scalar_names)
         self.dtype = jnp.zeros((), dtype).dtype
+        self.dtypes = {k: jnp.zeros((), v).dtype
+                       for k, v in dict(dtypes or {}).items()}
         self.interpret = _is_cpu() if interpret is None else interpret
 
         nwin = sum(self.win_defs.values())
@@ -275,11 +283,21 @@ class ResidentStencil:
                           for s in self.extra_defs.values())
                + sum(int(np.prod(s)) if s else 1
                      for s in self.out_defs.values()))
-        need = (nio + 3 * nwin) * X * Y * Z * self.dtype.itemsize
+        # RollTaps memoizes every distinct (sx, sy, sz) offset, so a
+        # radius-h centered-difference body materializes up to 2h
+        # shifted whole-lattice copies per axis per window stack (plus
+        # the partial-roll intermediates x->xy->xyz composition makes):
+        # budget ~(6h + 2) whole-lattice temporaries per window
+        # component rather than a flat 3, so the Python-level gate
+        # fires before Mosaic's VMEM allocator rejects the kernel with
+        # no fallback (ADVICE r4).
+        ntemp = 6 * self.h + 2
+        need = (nio + ntemp * nwin) * X * Y * Z * self.dtype.itemsize
         if need > budget:
             raise ValueError(
                 f"resident stencil on lattice {self.lattice_shape} with "
-                f"{nio} lattice arrays (+~3 temps) needs ~"
+                f"{nio} lattice arrays (+~{ntemp} tap temps per window "
+                f"component at radius {self.h}) needs ~"
                 f"{need / 2**20:.0f} MB VMEM > the {budget / 2**20:.0f} MB "
                 "budget; use the streaming kernels or the halo path")
         self._call = self._build()
@@ -304,9 +322,10 @@ class ResidentStencil:
                       for n, r in zip(self.extra_defs, extra_refs)}
             outs = self.body(taps, extras, scalars)
             for n, ref in zip(self.out_defs, out_refs[:no]):
-                ref[...] = outs[n]
+                ref[...] = outs[n].astype(ref.dtype)
             for n, ref in zip(self.sum_defs, out_refs[no:]):
-                ref[...] = outs[n].reshape(self.sum_defs[n], 1)
+                ref[...] = outs[n].astype(ref.dtype).reshape(
+                    self.sum_defs[n], 1)
 
         def whole(lead):
             shape = tuple(lead) + self.lattice_shape
@@ -318,8 +337,8 @@ class ResidentStencil:
         in_specs += [whole(lead) for lead in self.extra_defs.values()]
         out_specs = [whole(lead) for lead in self.out_defs.values()]
         out_shapes = [jax.ShapeDtypeStruct(lead + self.lattice_shape,
-                                           self.dtype)
-                      for lead in self.out_defs.values()]
+                                           self.dtypes.get(n, self.dtype))
+                      for n, lead in self.out_defs.items()]
         for nt in self.sum_defs.values():
             out_specs.append(pl.BlockSpec((nt, 1), lambda: (0, 0)))
             out_shapes.append(jax.ShapeDtypeStruct((nt, 1), self.dtype))
@@ -387,7 +406,7 @@ class StreamingStencil:
     def __init__(self, lattice_shape, win_defs, h, body, out_defs,
                  extra_defs=None, scalar_names=(), dtype=jnp.float32,
                  bx=None, by=None, x_halo=False, y_halo=False,
-                 interpret=None, sum_defs=None):
+                 interpret=None, sum_defs=None, dtypes=None):
         if h > HY:
             raise ValueError(f"stencil radius {h} exceeds aligned halo {HY}")
         self.lattice_shape = X, Y, Z = tuple(int(s) for s in lattice_shape)
@@ -405,6 +424,13 @@ class StreamingStencil:
         # canonicalize (f64 -> f32 when x64 is disabled) so out_shapes and
         # in-kernel values agree
         self.dtype = jnp.zeros((), dtype).dtype
+        #: per-array dtype overrides (windowed inputs / extras / outputs)
+        #: for mixed precision, e.g. bfloat16 RK carries riding f32 state
+        #: (the fused steppers' ``carry_dtype``). Bodies see the storage
+        #: dtype in taps/extras (jnp promotion upcasts against the f32
+        #: scalars); outputs are cast to their storage dtype on write.
+        self.dtypes = {k: jnp.zeros((), v).dtype
+                       for k, v in dict(dtypes or {}).items()}
         if bx is None or by is None:
             cbx, cby = choose_blocks(
                 sum(self.win_defs.values()), self.lattice_shape, self.h,
@@ -474,7 +500,8 @@ class StreamingStencil:
                      for n in self.extra_defs]
         out_specs = [block_spec(self.out_defs[n], 0) for n in self.out_defs]
         out_shapes = [
-            jax.ShapeDtypeStruct(self.out_defs[n] + (X, by, Z), self.dtype)
+            jax.ShapeDtypeStruct(self.out_defs[n] + (X, by, Z),
+                                 self.dtypes.get(n, self.dtype))
             for n in self.out_defs]
         nbx = X // bx
         for nt in self.sum_defs.values():
@@ -506,9 +533,10 @@ class StreamingStencil:
         outs = self.body(taps, extras, scalars)
         nlat = len(self.out_defs)
         for n, ref in zip(self.out_defs, out_refs[:nlat]):
-            ref[...] = outs[n]
+            ref[...] = outs[n].astype(ref.dtype)
         for n, ref in zip(self.sum_defs, out_refs[nlat:]):
-            ref[...] = outs[n].reshape(self.sum_defs[n], 1, 1)
+            ref[...] = outs[n].astype(ref.dtype).reshape(
+                self.sum_defs[n], 1, 1)
 
     def _build(self, j):
         if self.x_halo:
@@ -582,8 +610,9 @@ class StreamingStencil:
             out_specs=out_specs,
             out_shape=out_shapes,
             scratch_shapes=[
-                pltpu.VMEM((C, R * bx, byw, Z), self.dtype)
-                for C in self.win_defs.values()
+                pltpu.VMEM((C, R * bx, byw, Z),
+                           self.dtypes.get(n, self.dtype))
+                for n, C in self.win_defs.items()
             ] + [pltpu.SemaphoreType.DMA((2,))],
             interpret=self.interpret,
         )
@@ -641,8 +670,9 @@ class StreamingStencil:
             out_specs=out_specs,
             out_shape=out_shapes,
             scratch_shapes=[
-                pltpu.VMEM((C, 2 * bxw, byw, Z), self.dtype)
-                for C in self.win_defs.values()
+                pltpu.VMEM((C, 2 * bxw, byw, Z),
+                           self.dtypes.get(n, self.dtype))
+                for n, C in self.win_defs.items()
             ] + [pltpu.SemaphoreType.DMA((2,))],
             interpret=self.interpret,
         )

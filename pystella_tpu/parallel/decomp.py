@@ -200,7 +200,7 @@ class DomainDecomposition:
         size = self.mesh.shape[axis_name]
         return [(i, (i + shift) % size) for i in range(size)]
 
-    def pad_with_halos(self, x, halo, lattice_axes=None):
+    def pad_with_halos(self, x, halo, lattice_axes=None, exchange=None):
         """Return ``x`` padded with periodic halos of width ``halo[d]`` along
         each lattice axis.
 
@@ -211,29 +211,66 @@ class DomainDecomposition:
         /root/reference/pystella/decomp.py:287-296,365-449); for unsharded
         axes the halo is a local periodic wrap (the reference's
         pack-unpack self-copy kernels, decomp.py:181-182).
+
+        ``exchange[d]`` (default ``halo[d]``) bounds the width actually
+        MOVED over the interconnect: when a consumer needs an
+        alignment-padded halo wider than its stencil radius (the
+        streaming kernels' 8-aligned y window pad,
+        :func:`~pystella_tpu.ops.pallas_stencil.sharded_halo`), only the
+        ``exchange[d]`` semantically-read rows ride ``ppermute`` and the
+        remaining ``halo[d] - exchange[d]`` alignment rows are LOCAL
+        zeros — cutting the per-stage ICI bytes by ``halo/exchange``
+        (4x for the h=2 y halo; the 64-chip scaling model's first knob,
+        bench_results/r05_scaling_model.md) without touching the
+        Mosaic-clean buffer layout. Callers must guarantee no tap reads
+        beyond ``exchange[d]`` (stencil taps reach at most the radius).
         """
         if np.isscalar(halo):
             halo = (halo,) * len(self.axis_names)
+        if exchange is None:
+            exchange = halo
+        elif np.isscalar(exchange):
+            exchange = (exchange,) * len(self.axis_names)
         if lattice_axes is None:
             lattice_axes = tuple(range(x.ndim - len(self.axis_names), x.ndim))
         for d, ax in enumerate(lattice_axes):
             h = halo[d]
             if h == 0:
                 continue
-            if h > x.shape[ax]:
+            e = min(int(exchange[d]), h)
+            # the unsharded alignment-pad branch below slices h rows, so
+            # the guard must bound the full halo width, not just the
+            # exchanged width
+            if (h if self.proc_shape[d] == 1 else e) > x.shape[ax]:
                 raise ValueError(
-                    f"halo width {h} exceeds the local block size "
-                    f"{x.shape[ax]} along axis {d}; use a wider grid or a "
-                    f"smaller mesh axis")
+                    f"halo width {h if self.proc_shape[d] == 1 else e} "
+                    f"exceeds the local block size {x.shape[ax]} along "
+                    f"axis {d}; use a wider grid or a smaller mesh axis")
             name = self.axis_names[d]
-            lo = lax.slice_in_dim(x, x.shape[ax] - h, x.shape[ax], axis=ax)
-            hi = lax.slice_in_dim(x, 0, h, axis=ax)
+            lo = lax.slice_in_dim(x, x.shape[ax] - e, x.shape[ax], axis=ax)
+            hi = lax.slice_in_dim(x, 0, e, axis=ax)
             if self.proc_shape[d] > 1:
                 # my right slab becomes right-neighbor's left halo and v.v.
                 left_halo = lax.ppermute(lo, name, self._perm(name, +1))
                 right_halo = lax.ppermute(hi, name, self._perm(name, -1))
+            elif e < h:
+                # unsharded with an alignment pad: wrap the full width
+                # locally (free — no interconnect), keeping the legacy
+                # all-real-rows layout
+                left_halo = lax.slice_in_dim(
+                    x, x.shape[ax] - h, x.shape[ax], axis=ax)
+                right_halo = lax.slice_in_dim(x, 0, h, axis=ax)
+                e = h
             else:
                 left_halo, right_halo = lo, hi
+            if e < h:
+                zshape = list(x.shape)
+                zshape[ax] = h - e
+                zeros = jnp.zeros(zshape, x.dtype)
+                left_halo = lax.concatenate([zeros, left_halo],
+                                            dimension=ax)
+                right_halo = lax.concatenate([right_halo, zeros],
+                                             dimension=ax)
             x = lax.concatenate([left_halo, x, right_halo], dimension=ax)
         return x
 
@@ -277,7 +314,10 @@ class DomainDecomposition:
             if n % p:
                 raise ValueError(
                     f"grid_shape {grid_shape} not divisible by proc_shape "
-                    f"{self.proc_shape}; choose divisible shapes")
+                    f"{self.proc_shape}; choose divisible shapes — "
+                    "pystella_tpu.advise_shapes(grid_shape, n_devices) "
+                    "lists the feasible meshes and the kernel tier each "
+                    "subsystem takes on them")
         return tuple(n // p for n, p in zip(grid_shape, self.proc_shape))
 
     def __repr__(self):

@@ -111,6 +111,7 @@ class Stepper:
             rhs = compile_rhs_dict(merged)
         self.rhs = rhs
         self.dt = dt
+        self._donate = bool(donate)
 
         def _step_impl(state, t, dt, rhs_args):
             carry = self.init_carry(state)
@@ -132,12 +133,22 @@ class Stepper:
         stage compiles once per (carry structure, rhs_args structure) and
         every later call is a single cached dispatch instead of an eager
         op-by-op walk of the stage update. Built lazily so subclasses with
-        their own ``__init__`` (fused steppers) get them too."""
+        their own ``__init__`` (fused steppers) get them too.
+
+        With ``donate=True`` each stage donates its input carry (every
+        stage fully replaces state and carry, and the reference-style
+        loop never reads the old one), holding the eager per-stage
+        driver's peak HBM at ~one state + one carry instead of two
+        (VERDICT r4 #7; peak-HBM table in doc/performance.md)."""
         if not hasattr(self, "_jit_stage"):
-            self._jit_stage = jax.jit(self.stage, static_argnums=0)
+            donate = getattr(self, "_donate", False)
+            self._jit_stage = jax.jit(
+                self.stage, static_argnums=0,
+                donate_argnums=(1,) if donate else ())
             self._jit_stage0 = jax.jit(
                 lambda state, t, dt, rhs_args:
-                    self.stage(0, self.init_carry(state), t, dt, rhs_args))
+                    self.stage(0, self.init_carry(state), t, dt, rhs_args),
+                donate_argnums=(0,) if donate else ())
 
     # -- whole-step interface ---------------------------------------------
 

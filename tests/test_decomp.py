@@ -42,6 +42,43 @@ def test_share_halos(decomp, grid_shape, proc_shape, h):
             f"halo mismatch at block {block_pos}"
 
 
+@pytest.mark.parametrize("proc_shape", [(2, 2, 1)], indirect=True)
+@pytest.mark.parametrize("grid_shape", [(16, 16, 16)], indirect=True)
+def test_pad_with_halos_exchange_narrowing(decomp, grid_shape, proc_shape):
+    """``exchange < halo``: only the exchanged rows ride ppermute; the
+    alignment rows beyond them are local zeros, and the exchanged rows
+    are bit-identical to the full exchange (the streaming kernels' y
+    window pads HY=8 but taps only reach the radius h — the 64-chip
+    scaling model's ICI-narrowing knob)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    rng = np.random.default_rng(7)
+    host = rng.random(grid_shape)
+    arr = decomp.shard(host)
+    halo, ex = (2, 8, 0), (2, 2, 2)
+
+    spec = decomp.spec(0)
+
+    def body(x):
+        return decomp.pad_with_halos(x, halo, exchange=ex)
+
+    padded = jax.jit(decomp.shard_map(body, spec, spec))(arr)
+    full = decomp.share_halos(arr, halo)
+
+    rank_shape = decomp.rank_shape(grid_shape)
+    padded_local = tuple(n + 2 * h for n, h in zip(rank_shape, halo))
+    for shard, ref in zip(padded.addressable_shards,
+                          full.addressable_shards):
+        got, want = np.asarray(shard.data), np.asarray(ref.data)
+        assert got.shape == want.shape == padded_local
+        # y rows within the exchanged width match the full exchange ...
+        assert np.array_equal(got[:, 6:-6], want[:, 6:-6])
+        # ... and the alignment rows beyond it are zeros
+        assert np.all(got[:, :6] == 0) and np.all(got[:, -6:] == 0)
+        # the x axis (exchange == halo) is untouched
+        assert np.array_equal(got[:, 8:-8], want[:, 8:-8])
+
+
 @pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1), (2, 2, 2)],
                          indirect=True)
 def test_gather_scatter_roundtrip(decomp, grid_shape, proc_shape):
