@@ -72,12 +72,17 @@ class PowerSpectra:
         self._kmags = jax.device_put(
             kmags.astype(self.rdtype), sharding)
 
-        def weights_impl(fk, k_power):
-            w = self._counts * self._kmags**k_power * jnp.abs(fk)**2
-            b = jnp.broadcast_to(self._bin_idx, w.shape)
+        # the sharded k-arrays are jit ARGUMENTS, not closure captures:
+        # multi-controller jax forbids closing over arrays that span
+        # non-addressable devices (exercised by tests/multihost_worker.py)
+        def weights_impl(fk, k_power, counts, kmags, bin_idx):
+            w = counts * kmags**k_power * jnp.abs(fk)**2
+            b = jnp.broadcast_to(bin_idx, w.shape)
             return b, w
 
-        self._weights = jax.jit(weights_impl)
+        jitted = jax.jit(weights_impl)
+        self._weights = lambda fk, k_power: jitted(
+            fk, k_power, self._counts, self._kmags, self._bin_idx)
 
     def bin_power(self, fk, queue=None, k_power=3, allocator=None):
         """Unnormalized binned power spectrum of a momentum-space field,

@@ -124,16 +124,29 @@ def weighted_bincount(decomp, bins, weights, num_bins, lattice_names=None):
     ``outer + (num_bins,)`` (float64, or int64 for counts). The shared
     primitive behind :class:`Histogrammer` and
     :class:`~pystella_tpu.PowerSpectra`."""
+    import jax
+
+    def fetch(partials):
+        """Per-device partials as a host array: a plain device_get on one
+        controller; under multi-controller ``jax.distributed`` the
+        device axis spans non-addressable shards, so every process
+        allgathers the global value instead (the multihost analog of
+        the reference's host-side MPI allreduce, histogram.py:199-206)."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(partials, tiled=True)
+        return np.asarray(partials)
+
     outer_shape = tuple(bins.shape[:-3])
     num_bins = int(num_bins)
     if weights is None:
         partials = _bincount_fn(decomp, outer_shape, num_bins, False,
                                 lattice_names)(bins)
-        h = np.asarray(partials).astype(np.int64).sum(axis=0)
+        h = fetch(partials).astype(np.int64).sum(axis=0)
     else:
         partials = _bincount_fn(decomp, outer_shape, num_bins, True,
                                 lattice_names)(bins, weights)
-        h = np.asarray(partials).astype(np.float64).sum(axis=0)
+        h = fetch(partials).astype(np.float64).sum(axis=0)
     return h.reshape(outer_shape + (num_bins,))
 
 

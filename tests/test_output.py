@@ -47,6 +47,67 @@ def test_sharded_snapshot_plain_numpy(tmp_path):
     assert np.array_equal(ps.ShardedSnapshot.load(d, 1)["x"], x)
 
 
+@pytest.mark.parametrize("proc_shape", [(2, 2, 2)], indirect=True)
+@pytest.mark.parametrize("grid_shape", [(16, 16, 16)], indirect=True)
+def test_sharded_snapshot_merge_streams(make_decomp, grid_shape,
+                                        proc_shape, tmp_path):
+    """merge() streams shard blocks straight into one output HDF5
+    (peak memory = one shard — the reference's x-slice-streamed gather
+    analog) and its box-tiling coverage check catches missing shards
+    without a full boolean mask."""
+    import h5py
+    decomp = make_decomp(proc_shape)
+    rng = np.random.default_rng(5)
+    f = rng.standard_normal((2,) + grid_shape)
+
+    d = str(tmp_path / "snaps")
+    with ps.ShardedSnapshot(d) as snap:
+        snap.save(3, f=decomp.shard(f))
+    out = str(tmp_path / "merged.h5")
+    shapes = ps.ShardedSnapshot.merge(d, 3, out)
+    assert shapes == {"f": f.shape}
+    with h5py.File(out, "r") as g:
+        assert np.array_equal(g["f"][...], f)
+
+    # a missing region must raise (delete one shard dataset)
+    with h5py.File(tmp_path / "snaps" / "shard-00000.h5", "a") as g:
+        grp = g["step_0000000003/f"]
+        del grp["shard0"]
+    with pytest.raises(ValueError, match="missing|cover"):
+        ps.ShardedSnapshot.merge(d, 3, str(tmp_path / "merged2.h5"))
+
+
+def test_sharded_snapshot_refuses_mixed_runs(tmp_path):
+    """Leftover shard files from a different run in the same directory
+    must never be silently merged (ADVICE r4): conflicting run ids or
+    per-array shape/dtype declarations raise."""
+    d = str(tmp_path / "snaps")
+    x = np.arange(8.0).reshape(2, 4)
+    with ps.ShardedSnapshot(d, run_id="run-a") as snap:
+        snap.save(1, x=x)
+    # same id: loads fine
+    assert np.array_equal(ps.ShardedSnapshot.load(d, 1)["x"], x)
+
+    # a second file with a different run id
+    import h5py
+    with h5py.File(tmp_path / "snaps" / "shard-00099.h5", "w") as f:
+        f.attrs["run_id"] = "run-b"
+    with pytest.raises(ValueError, match="run ids"):
+        ps.ShardedSnapshot.load(d, 1)
+
+    # and (separately) a same-name array with a different declared shape
+    d2 = str(tmp_path / "snaps2")
+    with ps.ShardedSnapshot(d2) as snap:
+        snap.save(1, x=x)
+    with h5py.File(tmp_path / "snaps2" / "shard-00099.h5", "w") as f:
+        g = f.create_group("step_0000000001/x")
+        g.attrs["global_shape"] = np.array([4, 4], np.int64)
+        ds = g.create_dataset("shard0", data=np.ones((4, 4)))
+        ds.attrs["start"] = np.array([0, 0], np.int64)
+    with pytest.raises(ValueError, match="different runs"):
+        ps.ShardedSnapshot.load(d2, 1)
+
+
 def test_sharded_snapshot_incomplete_raises(tmp_path):
     """A missing / partially-written host file must raise, never return
     uninitialized memory."""
