@@ -42,7 +42,7 @@ from pystella_tpu.models import (
 )
 from pystella_tpu.utils import (Checkpointer, HealthMonitor,
     SimulationDiverged, OutputFile, ShardedSnapshot, StepTimer, timer,
-    trace)
+    trace, advise_shapes)
 from pystella_tpu.step import (
     Stepper, RungeKuttaStepper, LowStorageRKStepper, compile_rhs_dict,
     RungeKutta4, RungeKutta3Heun, RungeKutta3Nystrom, RungeKutta3Ralston,

@@ -1,8 +1,10 @@
+from pystella_tpu.utils.advisor import MeshAdvice, ShapeReport, advise_shapes
 from pystella_tpu.utils.checkpoint import Checkpointer
 from pystella_tpu.utils.monitor import HealthMonitor, SimulationDiverged
 from pystella_tpu.utils.output import OutputFile, ShardedSnapshot
 from pystella_tpu.utils.profiling import StepTimer, timer, trace
 
-__all__ = ["Checkpointer", "HealthMonitor", "SimulationDiverged",
+__all__ = ["MeshAdvice", "ShapeReport", "advise_shapes",
+           "Checkpointer", "HealthMonitor", "SimulationDiverged",
            "OutputFile", "ShardedSnapshot", "StepTimer", "timer",
            "trace"]

@@ -1,0 +1,78 @@
+"""Shape-advisor tests: the report must mirror the gates where they are
+enforced (divisibility in decomp.rank_shape, Z % 128 lanes and VMEM fits
+in ops/pallas_stencil.py, the DFT scheme tiers in fourier/dft.py) —
+VERDICT r4 #9 / missing #1 (the reference supports uneven shards,
+decomp.py:322-337; this framework requires divisibility and must make
+choosing divisible shapes a one-table exercise)."""
+
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+
+
+def test_feasible_meshes_and_tiers():
+    rep = ps.advise_shapes((512, 512, 512), n_devices=8)
+    shapes = [m.proc_shape for m in rep.meshes]
+    # every ordered factorization of 8 divides 512^3
+    assert len(shapes) == 10 and not rep.infeasible
+    best = rep.best()
+    # the recommendation keeps the fused tier and the pencil FFT, and
+    # z-sharded meshes rank below x/y-sharded ones
+    assert best.proc_shape[2] == 1
+    assert best.tiers["fused stepper"] == "streaming"
+    assert best.tiers["distributed FFT"] == "pencil"
+    zs = next(m for m in rep.meshes if m.proc_shape == (2, 2, 2))
+    assert zs.tiers["fused stepper"].startswith("generic")
+    assert "512" in rep.format() or "2x4x1" in rep.format()
+
+
+def test_divisibility_failures_reported():
+    rep = ps.advise_shapes((500, 500, 500), n_devices=8)
+    # 500 = 4*125: p=8 never divides, so only meshes with axis factors
+    # in {1,2,4} survive
+    for m in rep.meshes:
+        assert all(n % p == 0 for n, p in zip((500,) * 3, m.proc_shape))
+    assert any(p == (8, 1, 1) for p, _ in rep.infeasible)
+
+
+def test_lane_rule_and_small_lattice_tiers():
+    # 64^3 single device: Z=64 is not lane-aligned -> no streaming, but
+    # the whole lattice fits VMEM -> resident
+    rep = ps.advise_shapes((64, 64, 64), n_devices=1, nscalars=1)
+    m = rep.best()
+    assert m.tiers["fused stepper"] == "resident"
+    assert m.tiers["FD operators"] == "resident"
+    assert any("lane-aligned" in n for n in m.notes)
+
+
+def test_gw_window_accounting():
+    # the 24-component preheat pair kernel has no feasible blocking at
+    # 512^3 (the measured VMEM cliff, tests/test_fused.py
+    # test_preheat_pair_degrades_at_production_size) — the advisor must
+    # report pair fusion unavailable while the single-stage kernel stays
+    rep = ps.advise_shapes((512, 512, 512), n_devices=1,
+                           gravitational_waves=True)
+    m = rep.best()
+    assert m.tiers["fused stepper"] == "streaming"
+    assert m.tiers["pair fusion"] == "no (VMEM)"
+
+
+def test_replicate_fft_flagged():
+    # grid (6, 6, 8) on a (1, 1, 4) z-sharded mesh is position-space
+    # feasible (8 % 4 == 0) but no distributed FFT scheme applies
+    # (6 % 4 != 0 kills pencil; partial needs pz == 1) -> replicate,
+    # flagged; the (2, 2, 1) mesh on the same grid keeps partial
+    rep = ps.advise_shapes((6, 6, 8), n_devices=4)
+    mz = next(mm for mm in rep.meshes if mm.proc_shape == (1, 1, 4))
+    assert mz.tiers["distributed FFT"] == "replicate!"
+    assert any("replicate" in n for n in mz.notes)
+    mxy = next(mm for mm in rep.meshes if mm.proc_shape == (2, 2, 1))
+    assert mxy.tiers["distributed FFT"] == "partial"
+
+
+def test_error_paths_reference_the_advisor():
+    devs = __import__("jax").devices()
+    decomp = ps.DomainDecomposition((2, 1, 1), devices=devs[:2])
+    with pytest.raises(ValueError, match="advise_shapes"):
+        decomp.rank_shape((15, 16, 16))
