@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-5 hardware re-validation session (VERDICT r4 next-round #1).
+# Run the moment the tunnel returns (bench_results/tunnel_status.json
+# flips to {"state": "ok"}). ONE client at a time — never run this
+# while any other process holds the tunnel, and never kill a running
+# leg (a killed client wedges the tunnel lease for 30+ minutes).
+#
+# Leg 1 — fresh bench.py, all configs: re-measures every cached line on
+#   the round-5 code (preheat 128/256/512, pallas+resident parity,
+#   wave-64^3 resident, gw-spectra batched, gw-step 256^3,
+#   gw-step 512^3 bf16-carry, coupled-science 512^3 via the
+#   deferred-drag pair path, multigrid-512^3 Pallas smoother, block
+#   sweep). Fresh lines overwrite the cache; the three stale round-3
+#   lines (wave/multigrid/gw-spectra, replaced code paths) are never
+#   replayed (cache_load drops "stale": true records).
+# Leg 2 — the Mosaic-compiled test suite log (everything round 4+5
+#   built finally compiled, not just interpreted).
+set -u
+cd /root/repo
+
+echo "[r05-session] leg 1: fresh bench (all configs) $(date -u)" >&2
+BENCH_TOTAL_BUDGET=3600 timeout 3700 python bench.py \
+  > bench_results/r05_bench_fresh.out 2> bench_results/r05_bench_fresh.err
+echo "rc=$?" >> bench_results/r05_bench_fresh.err
+
+echo "[r05-session] leg 2: Mosaic-compiled suite $(date -u)" >&2
+PYSTELLA_TEST_PLATFORM=tpu timeout 5400 python -m pytest tests/ -q \
+  --deselect tests/test_multihost.py \
+  > bench_results/r05_tpu_suite.log 2>&1
+echo "rc=$?" >> bench_results/r05_tpu_suite.log
+tail -3 bench_results/r05_tpu_suite.log >&2
+echo "[r05-session] done $(date -u)" >&2
