@@ -239,8 +239,9 @@ class RelaxationBase:
         inv_dx2 = [1.0 / d**2 for d in level.dx]
         aux_lat = [k for k, kk in aux_struct if kk == "lattice"]
         aux_scal = [k for k, kk in aux_struct if kk == "scalar"]
-        exprs = (self.step_exprs if kind == "smooth"
-                 else self.resid_exprs)
+        exprs = {"smooth": self.step_exprs,
+                 "residual": self.resid_exprs,
+                 "tau": self.lhs_exprs}[kind]
 
         def body(taps, extras, scalars):
             fs = taps()
@@ -250,17 +251,21 @@ class RelaxationBase:
             for i, n in enumerate(names):
                 env[n] = fs[i]
                 env["lap_" + n] = lap[i]
-                env[self.f_to_rho_dict[n]] = extras["rhos"][i]
+                if kind != "tau":
+                    env[self.f_to_rho_dict[n]] = extras["rhos"][i]
             for k in aux_lat:
                 env[k] = extras[k]
             for k in aux_scal:
                 env[k] = scalars[k]
-            out = jnp.stack([
-                jnp.broadcast_to(
-                    jnp.asarray(evaluate(exprs[n], env), fs.dtype),
-                    fs.shape[1:])
-                for n in names])
-            return {"out": out}
+            vals = [jnp.broadcast_to(
+                jnp.asarray(evaluate(exprs[n], env), fs.dtype),
+                fs.shape[1:]) for n in names]
+            if kind == "tau":
+                # FAS coarse rho: restricted fine residual (riding the
+                # "rhos" extras slot) + the coarse operator
+                vals = [extras["rhos"][i] + v
+                        for i, v in enumerate(vals)]
+            return {"out": jnp.stack(vals)}
 
         st = None
         if feasible:
@@ -358,9 +363,19 @@ class RelaxationBase:
             fs, rhos, aux)
 
     def tau_rhs(self, level, fs, restricted_resid, aux, decomp=None):
-        """Coarse-level rho with FAS tau-correction."""
-        return self._get_compiled("tau", level, None, decomp)(
-            self._cast(fs), self._cast(restricted_resid), self._cast(aux))
+        """Coarse-level rho with FAS tau-correction. Takes the Pallas
+        stencil tier when the level admits it (the same kernel shape as
+        ``residual``; VERDICT r4 #4), else the XLA halo-pad path."""
+        decomp = decomp if decomp is not None else self.decomp
+        fs = self._cast(fs)
+        rr = self._cast(restricted_resid)
+        aux = self._cast(aux)
+        res = self._try_pallas(
+            "tau", level, fs,
+            {self.f_to_rho_dict[n]: rr[n] for n in fs}, aux, decomp)
+        if res is not None:
+            return {self.f_to_rho_dict[n]: res[n] for n in res}
+        return self._get_compiled("tau", level, None, decomp)(fs, rr, aux)
 
     def error_arrays(self, level, fs, rhos, aux, decomp=None):
         """Residual norms as DEVICE scalars — no host sync, so cycle

@@ -235,6 +235,12 @@ def test_pallas_smoother_matches_xla(make_decomp, grid_shape, proc_shape):
     r_got = s_pal.residual(level, {"f": f}, {"rho": rho}, {}, decomp)["f"]
     assert np.max(np.abs(np.asarray(r_got) - np.asarray(r_ref))) < 1e-12
 
+    # the FAS tau-correction right-hand side takes the same tier
+    # (VERDICT r4 #4: residual + tau_rhs on the kernel path)
+    t_ref = s_xla.tau_rhs(level, {"f": f}, {"f": rho}, {}, decomp)["rho"]
+    t_got = s_pal.tau_rhs(level, {"f": f}, {"f": rho}, {}, decomp)["rho"]
+    assert np.max(np.abs(np.asarray(t_got) - np.asarray(t_ref))) < 1e-12
+
 
 def test_pallas_smoother_full_cycle(make_decomp, grid_shape):
     """A full FAS solve with the Pallas smoother converges to the same
