@@ -26,7 +26,12 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_cluster(tmp_path):
+@pytest.mark.parametrize("nproc", [2, 3])
+def test_process_cluster(tmp_path, nproc):
+    """2- and 3-process clusters (each contributing 2 devices) — the
+    analog of the reference CI's even/odd process-count matrix
+    (``mpirun -np 4`` and ``-np 3``, ci.yml:96-97): the odd count
+    catches layout bugs that even divisibility hides."""
     coordinator = f"localhost:{_free_port()}"
 
     env = dict(os.environ)
@@ -39,10 +44,10 @@ def test_two_process_cluster(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, coordinator, str(i),
-             str(tmp_path / "snaps")],
+             str(tmp_path / "snaps"), str(nproc)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
-        for i in range(2)]
+        for i in range(nproc)]
 
     outputs = []
     try:
