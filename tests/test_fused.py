@@ -527,9 +527,16 @@ def test_preheat_pair_degrades_at_production_size(decomp):
     # the single-stage kernel remains available at this size
     assert stepper._both_st.bx >= 2
 
+    # ... and the coupled chunk follows the same split: GW degrades to
+    # single-stage coupled kernels (pairing is already off), while the
+    # scalar system's 8-window deferred coupled pair has a valid
+    # blocking — coupled-science-512^3 benches the PAIR path
+    assert stepper._ensure_coupled_pair_calls() is None
+
     scalar = FusedScalarStepper(sector, decomp, (512, 512, 512), 0.01, 2,
                                 dtype=jnp.float32, **_XKW)
     assert scalar._pair_call is not None
+    assert scalar._ensure_coupled_pair_calls() is not None
 
     # explicitly pinned pair blocking is honored verbatim (no degrade)
     pinned = FusedPreheatStepper(sector, gw, decomp, (512, 512, 512),
