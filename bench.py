@@ -770,10 +770,13 @@ def payload(platform_wanted):
                         bf_n, carry_dtype=_jnp.bfloat16),
                     "site-updates/s", 1e9, 2 * budget))
             cp_n = int(os.environ.get("BENCH_COUPLED_N", "512"))
+            # 2x budget: the deferred-drag pair path Mosaic-compiles two
+            # kernel variants (normal-in + deferred-in) per y-slab plus
+            # the single-stage energy kernel for odd tails
             configs.insert(3, (
                 f"coupled-science-{cp_n}^3",
                 lambda: run_coupled(cp_n), "site-updates/s", 1e9,
-                budget))
+                2 * budget))
         for label, fn, unit, base, cfg_budget in configs:
             try:
                 hb(f"extra config: {label}")

@@ -306,18 +306,27 @@ def test_coupled_multi_step_gw(decomp):
     assert abs(expand.a - expand_ref.a) / expand_ref.a < 1e-12
 
     # deferred-drag pair-fused coupled chunk for the full scalar+GW
-    # system: exact, so driver-loop parity to roundoff here too
-    energy0 = energy_of(state, 1.0)
-    expand_p = ps.Expansion(energy0["total"], ps.LowStorageRK54)
-    fresh = {k: _arr(np.asarray(v)) for k, v in state.items()}
-    got_p = fused.coupled_multi_step(fresh, nsteps, expand_p, 0.0, dt,
-                                     grid_size=grid_size, pair=True)
-    for name in ("f", "dfdt", "hij", "dhijdt"):
-        err = np.max(np.abs(np.asarray(got_p[name])
-                            - np.asarray(ref[name])))
-        scale = max(np.max(np.abs(np.asarray(ref[name]))), 1e-30)
-        assert err / scale < 1e-12, \
-            f"{name}: pair-coupled diverges ({err})"
+    # system: exact, so driver-loop parity to roundoff here too.
+    # nsteps=1 (5 flat stages) exercises the preheat odd-tail path —
+    # mid-chunk finalize of the deferred tensor drag + the single-stage
+    # energy kernel; nsteps=2 ends on a deferred pair, exercising the
+    # chunk-end finalize
+    for n_pair in (1, 2):
+        ref_p = fused.coupled_multi_step(
+            {k: _arr(np.asarray(v)) for k, v in state.items()},
+            n_pair, ps.Expansion(energy0["total"], ps.LowStorageRK54),
+            0.0, dt, grid_size=grid_size, pair=False)
+        expand_p = ps.Expansion(energy0["total"], ps.LowStorageRK54)
+        fresh = {k: _arr(np.asarray(v)) for k, v in state.items()}
+        got_p = fused.coupled_multi_step(fresh, n_pair, expand_p, 0.0,
+                                         dt, grid_size=grid_size,
+                                         pair=True)
+        for name in ("f", "dfdt", "hij", "dhijdt"):
+            err = np.max(np.abs(np.asarray(got_p[name])
+                                - np.asarray(ref_p[name])))
+            scale = max(np.max(np.abs(np.asarray(ref_p[name]))), 1e-30)
+            assert err / scale < 1e-12, \
+                f"{name}@{n_pair}: pair-coupled diverges ({err})"
     assert abs(expand_p.a - expand_ref.a) / expand_ref.a < 1e-12
 
 
