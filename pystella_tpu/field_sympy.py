@@ -20,7 +20,7 @@ from __future__ import annotations
 import numbers
 
 from pystella_tpu.field import (
-    Call, Constant, DynamicField, Expr, Field, Indexed, Power, Product,
+    Call, Constant, Field, Indexed, Power, Product,
     Quotient, Shifted, Sum, Var, _wrap,
 )
 

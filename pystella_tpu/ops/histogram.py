@@ -26,7 +26,6 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 import weakref
 

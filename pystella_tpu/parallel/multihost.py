@@ -16,7 +16,6 @@ changes, exactly like the reference's graceful single-rank fallback.
 
 from __future__ import annotations
 
-import numpy as np
 
 import jax
 
