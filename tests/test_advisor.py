@@ -71,6 +71,31 @@ def test_replicate_fft_flagged():
     assert mxy.tiers["distributed FFT"] == "partial"
 
 
+def test_advisor_matches_fused_construction():
+    """The advisor's 'fused stepper' tier must agree with what
+    FusedScalarStepper actually selects when built for the COMPILED
+    path (interpret=False applies the real Z%128 / VMEM gates at
+    construction; no kernel is executed)."""
+    import jax
+    import jax.numpy as jnp
+    from pystella_tpu.ops.fused import FusedScalarStepper
+    from pystella_tpu.ops.pallas_stencil import (ResidentStencil,
+                                                 StreamingStencil)
+
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+    sector = ps.ScalarSector(2, potential=lambda f: 0.5 * f[0]**2
+                             + 0.5 * f[1]**2)
+    for grid in [(64, 64, 64), (128, 128, 128)]:
+        tier = ps.advise_shapes(grid, 1).best().tiers["fused stepper"]
+        fs = FusedScalarStepper(sector, decomp, grid, 0.3, 2,
+                                dtype=jnp.float32, interpret=False)
+        got = ("streaming" if isinstance(fs._scalar_st, StreamingStencil)
+               else "resident" if isinstance(fs._scalar_st,
+                                             ResidentStencil)
+               else "?")
+        assert got == tier, f"{grid}: advisor says {tier}, built {got}"
+
+
 def test_error_paths_reference_the_advisor():
     devs = __import__("jax").devices()
     decomp = ps.DomainDecomposition((2, 1, 1), devices=devs[:2])
