@@ -18,6 +18,12 @@
 set -u
 cd /root/repo
 
+echo "[r05-session] leg 0: Mosaic feature smoke $(date -u)" >&2
+timeout 1800 python bench_results/r05_mosaic_smoke.py \
+  > bench_results/r05_mosaic_smoke.out 2> bench_results/r05_mosaic_smoke.err
+echo "rc=$?" >> bench_results/r05_mosaic_smoke.err
+cat bench_results/r05_mosaic_smoke.out >&2
+
 echo "[r05-session] leg 1: fresh bench (all configs) $(date -u)" >&2
 BENCH_TOTAL_BUDGET=3600 timeout 3700 python bench.py \
   > bench_results/r05_bench_fresh.out 2> bench_results/r05_bench_fresh.err
