@@ -83,7 +83,8 @@ class MeshAdvice:
 
 
 TIER_KEYS = ("fused stepper", "pair fusion", "coupled pair",
-             "FD operators", "distributed FFT", "multigrid depth")
+             "FD operators", "distributed FFT", "multigrid depth",
+             "HBM/device")
 
 
 @dataclass
@@ -235,6 +236,25 @@ def advise_shapes(grid_shape, n_devices=1, halo_shape=2,
             loc = [n // 2 for n in loc]
             depth += 1
         m.tiers["multigrid depth"] = str(depth)
+
+        # peak HBM per device for the hot loop: one state + one carry
+        # (4 arrays per field component with per-stage donation —
+        # doc/performance.md "Memory"); bfloat16 carries halve the
+        # carry half (carry_dtype=jnp.bfloat16 on the fused steppers)
+        sites = int(np.prod(local))
+        narr = 2 * (F + H)  # state: (y, dy) per component
+        gb = narr * sites * itemsize * 2 / 1e9  # + same-size carry
+        gb_bf16 = narr * sites * itemsize * 1.5 / 1e9
+        tag = f"~{gb:.1f} GB"
+        if gb > 16:
+            tag += (f" (>16! bf16 carries: ~{gb_bf16:.1f} GB)"
+                    if gb_bf16 <= 16 else " (>16 GB: shard wider)")
+            m.notes.append(
+                f"f32-carry peak ~{gb:.1f} GB/device exceeds a 16 GB "
+                f"chip; carry_dtype=jnp.bfloat16 gives ~{gb_bf16:.1f} "
+                "GB" + ("" if gb_bf16 <= 16 else
+                        " — still over; use a larger mesh"))
+        m.tiers["HBM/device"] = tag
 
         if local[2] % LANE and pz == 1:
             m.notes.append(

@@ -56,6 +56,11 @@ def test_gw_window_accounting():
     m = rep.best()
     assert m.tiers["fused stepper"] == "streaming"
     assert m.tiers["pair fusion"] == "no (VMEM)"
+    # ... and the HBM column flags the 17.2 GB f32 peak with the bf16
+    # carry remedy (the doc/performance.md "Memory" numbers)
+    assert "17.2" in m.tiers["HBM/device"]
+    assert "12.9" in m.tiers["HBM/device"]
+    assert any("bfloat16" in n for n in m.notes)
 
 
 def test_replicate_fft_flagged():
