@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-5 tunnel waiter (restartable): dial the TPU tunnel every 5
+# minutes; the moment a dial succeeds, fire the armed hardware session
+# (r05_tpu_session.sh) and exit. Single-client discipline: one probe at
+# a time, never killed mid-dial (an outage dial self-returns
+# UNAVAILABLE after ~25 min; killing it wedges the server-side lease).
+# Status after every attempt -> bench_results/tunnel_status.json
+# (untracked runtime file).
+set -u
+cd /root/repo
+STATUS=bench_results/tunnel_status.json
+DEADLINE=$(( $(date -u +%s) + ${WAITER_BUDGET_S:-41400} ))  # default 11.5 h
+
+attempt=0
+while [ "$(date -u +%s)" -lt "$DEADLINE" ]; do
+  attempt=$((attempt+1))
+  started=$(date -u +%FT%TZ)
+  echo "[waiter] attempt $attempt dialing at $started" >&2
+  if python - <<'EOF' 2> bench_results/r05_waiter_dial.err
+import jax
+devs = jax.devices()
+assert devs and devs[0].platform == "tpu", devs
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+assert float((x @ x).sum()) == 128.0 * 128 * 128
+print(f"dial ok: {devs}")
+EOF
+  then
+    printf '{"state": "ok", "attempt": %d, "ts": "%s"}\n' \
+      "$attempt" "$(date -u +%FT%TZ)" > "$STATUS"
+    echo "[waiter] tunnel OK on attempt $attempt; firing session" >&2
+    bash bench_results/r05_tpu_session.sh \
+      > bench_results/r05_session.out 2> bench_results/r05_session.err
+    echo "[waiter] session complete rc=$? at $(date -u)" >&2
+    exit 0
+  fi
+  printf '{"state": "UNAVAILABLE", "attempt": %d, "started": "%s", "ended": "%s", "err_tail": %s}\n' \
+    "$attempt" "$started" "$(date -u +%FT%TZ)" \
+    "$(tail -c 300 bench_results/r05_waiter_dial.err | python -c 'import json,sys; print(json.dumps(sys.stdin.read()))')" \
+    > "$STATUS"
+  sleep 300
+done
+echo "[waiter] deadline reached; tunnel never returned" >&2
+exit 1
