@@ -401,7 +401,7 @@ def run_gw_spectra(n=256, nreps=5):
 
 
 def build_gw_step(grid_shape, dtype=np.float32, decomp=None,
-                  carry_dtype=None):
+                  carry_dtype=None, assemble=None):
     """Construct the full scalar+GW preheating system (the one model that
     REQUIRES multi-chip at 512^3: ~17 GB f32 state+carry > one v5e's
     HBM) on ``decomp``'s mesh; returns ``(stepper, state, dt)`` like
@@ -422,9 +422,14 @@ def build_gw_step(grid_shape, dtype=np.float32, decomp=None,
     sector = ps.ScalarSector(2, potential=potential)
     gw = ps.TensorPerturbationSector([sector])
     kw = {} if carry_dtype is None else {"carry_dtype": carry_dtype}
+    if assemble is None:
+        # the 512^3 single-chip config misses 16 GB by 183 MB with the
+        # default concat slab assembly (measured; ~2 GB of live slab
+        # temps) — the update-slice chain frees them
+        assemble = "update" if int(np.prod(grid_shape)) >= 512**3 else "concat"
     stepper = ps.FusedPreheatStepper(sector, gw, decomp, grid_shape,
                                      lattice.dx, 2, dtype=dtype, dt=dt,
-                                     **kw)
+                                     assemble=assemble, **kw)
     rng = np.random.default_rng(9)
     state = {
         "f": decomp.shard(

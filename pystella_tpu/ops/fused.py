@@ -85,7 +85,7 @@ class FusedScalarStepper(_step.Stepper):
                  tableau=None, dtype=jnp.float32, bx=None, by=None,
                  dt=None, pair_stages=True, pair_bx=None, pair_by=None,
                  interpret=None, donate=False, resident=None,
-                 carry_dtype=None, **kwargs):
+                 carry_dtype=None, assemble="concat", **kwargs):
         tableau = tableau or _step.LowStorageRK54
         self._A = tableau._A
         self._B = tableau._B
@@ -137,6 +137,18 @@ class FusedScalarStepper(_step.Stepper):
         # convergence-order-critical runs).
         self._carry_dtype = (None if carry_dtype is None
                              else jnp.zeros((), carry_dtype).dtype)
+        #: y-slab output assembly for the streaming kernels:
+        #: ``"update"`` trades one zero-init write per output for ~one
+        #: full output set of peak HBM (what lets the 512**3 GW
+        #: bf16-carry step fit a single v5e — it misses by 183 MB under
+        #: the default ``"concat"``; doc/performance.md "Memory").
+        #: Validated HERE (not just in StreamingStencil) because
+        #: _build_stencil treats construction ValueErrors as "no feasible
+        #: blocking" and falls back — a typo would silently change tiers.
+        if assemble not in ("concat", "update"):
+            raise TypeError(f"assemble must be 'concat'/'update', "
+                            f"got {assemble!r}")
+        self._assemble = assemble
         self._build_kernels(bx, by)
 
         # jitted whole-step (one XLA computation, all stages fused).
@@ -181,7 +193,8 @@ class FusedScalarStepper(_step.Stepper):
             try:
                 return StreamingStencil(
                     self.local_shape, win_defs, self.h, body, out_defs,
-                    bx=bx, by=by, **self._halo_kw, **common)
+                    bx=bx, by=by, assemble=self._assemble,
+                    **self._halo_kw, **common)
             except ValueError:
                 # no resident fallback for sharded lattices (resident
                 # taps assume LOCAL periodicity) or explicitly pinned

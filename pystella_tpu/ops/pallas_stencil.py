@@ -33,6 +33,8 @@ smoothers. On CPU backends the kernels run in Pallas interpret mode.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -42,7 +44,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["StreamingStencil", "ResidentStencil", "Taps", "HY", "LANE",
            "choose_blocks", "sharded_halo", "lap_from_taps",
-           "grad_from_taps"]
+           "grad_from_taps", "VMEM_LIMIT_BYTES"]
 
 #: aligned y-halo width (one sublane tile); must be >= the stencil radius
 HY = 8
@@ -57,6 +59,27 @@ HY = 8
 LANE = 128
 
 _RING = 4  # x-block ring slots: 3 live + 1 in flight
+
+#: Scoped-VMEM limit requested from Mosaic for every compiled stencil
+#: kernel. XLA's *default* scoped limit is 16 MB (measured on v5e: the
+#: 25 MB wave-64^3 resident kernel compiled fine in interpret mode but
+#: Mosaic rejected it with "Scoped allocation with size 25.40M and limit
+#: 16.00M exceeded scoped vmem limit"), far below the 128 MB of physical
+#: VMEM — so the Python-level budgets (``choose_blocks``,
+#: ``ResidentStencil(budget=...)``) were silently stricter than they
+#: claimed. Requesting the limit per kernel via
+#: ``CompilerParams(vmem_limit_bytes=...)`` makes the physical capacity
+#: available; 100 MB leaves headroom for Mosaic's own scratch.
+VMEM_LIMIT_BYTES = int(
+    float(os.environ.get("PYSTELLA_VMEM_LIMIT_MB", "100")) * 2**20)
+
+
+def _compiler_params(interpret):
+    """Mosaic compiler params for compiled kernels (None in interpret
+    mode — TPU-specific params are meaningless there)."""
+    if interpret:
+        return None
+    return pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT_BYTES)
 
 
 def sharded_halo(h, px, py):
@@ -83,7 +106,7 @@ def _rem(a, m):
 
 
 def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
-                  budget=24 * 2**20):
+                  budget=None):
     """Pick ``(bx, by)`` fitting the VMEM budget: the window ring, the
     double-buffered extra inputs / outputs, and ~3 window-sized compute
     temporaries.
@@ -92,10 +115,17 @@ def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
     largest feasible ``by`` (fewer per-stage pallas_calls, wider DMA
     rows), then the *smallest* feasible ``bx >= h`` — small x-blocks keep
     the ring slots cheap and pipeline best ((2,128) beat every bx>=4
-    blocking at 128^3; (2,64) beat (2,32) at 512^3). The 24 MB budget is
-    the largest for which every selected blocking has been observed to
-    pass Mosaic's VMEM allocator at 512^3 (a (2,128)/45 MB-estimate
-    blocking fails to compile there)."""
+    blocking at 128^3; (2,64) beat (2,32) at 512^3). The default 24 MB
+    budget (env ``PYSTELLA_BLOCK_BUDGET_MB``) was calibrated when the
+    kernels ran under XLA's default 16 MB scoped-VMEM limit; the round-5
+    ``vmem_limit_bytes`` request raises the real ceiling to
+    ``PYSTELLA_VMEM_LIMIT_MB`` (100 MB), so larger budgets are now
+    *compilable* — the measured preference for small blocks keeps the
+    conservative default until a sweep shows bigger wins
+    (bench_results/r05_pair_sweep.py)."""
+    if budget is None:
+        budget = int(float(
+            os.environ.get("PYSTELLA_BLOCK_BUDGET_MB", "24")) * 2**20)
     X, Y, Z = lattice_shape
     best = None
     for by in (256, 128, 64, 32, 16, 8):
@@ -172,7 +202,9 @@ class Taps:
         the stage-pair kernel's Laplacian of the intermediate field)."""
         if self._interpret:
             return jnp.roll(arr, -sz, axis=3)
-        return pltpu.roll(arr, (self._Z - sz) % self._Z, 3)
+        # int32 shift: under x64 a bare python int traces as i64, which
+        # tpu.dynamic_rotate rejects (caught by tests/test_tpu_lowering.py)
+        return pltpu.roll(arr, jnp.int32((self._Z - sz) % self._Z), 3)
 
 
 def lap_from_taps(taps, coefs, inv_dx2):
@@ -222,7 +254,8 @@ class RollTaps:
         if self._interpret:
             return jnp.roll(arr, -s, axis)
         n = arr.shape[axis]
-        return pltpu.roll(arr, (n - s) % n, axis)
+        # int32 shift: see Taps.roll
+        return pltpu.roll(arr, jnp.int32((n - s) % n), axis)
 
     def __call__(self, sx=0, sy=0, sz=0):
         key = (sx, sy, sz)
@@ -348,6 +381,7 @@ class ResidentStencil:
             out_specs=out_specs,
             out_shape=out_shapes,
             interpret=self.interpret,
+            compiler_params=_compiler_params(self.interpret),
         )
 
     def __call__(self, f, scalars=None, extras=None):
@@ -395,18 +429,20 @@ class StreamingStencil:
         for guaranteed Mosaic-clean windows).
     :arg sum_defs: dict name -> term count: lattice-summed outputs. The
         body returns a ``(nterms,)`` vector of block sums per name; each
-        grid program writes its partial into a ``(nterms, nbx, 1)``
-        output and :meth:`__call__` finishes the reduction (over
-        programs and y-slabs) outside the kernel — deterministic
-        summation order, no cross-program accumulation. This is how
-        fused RK stages emit energy reductions of their input state for
-        free (the whole state is already in VMEM).
+        grid program adds its partial into one ``(nt_pad8, LANE)``
+        accumulator tile revisited across the (sequential) grid, and
+        :meth:`__call__` finishes the reduction over y-slabs outside the
+        kernel — deterministic summation order (program order is fixed),
+        one tile writeback per kernel. This is how fused RK stages emit
+        energy reductions of their input state for free (the whole state
+        is already in VMEM).
     """
 
     def __init__(self, lattice_shape, win_defs, h, body, out_defs,
                  extra_defs=None, scalar_names=(), dtype=jnp.float32,
                  bx=None, by=None, x_halo=False, y_halo=False,
-                 interpret=None, sum_defs=None, dtypes=None):
+                 interpret=None, sum_defs=None, dtypes=None,
+                 assemble="concat"):
         if h > HY:
             raise ValueError(f"stencil radius {h} exceeds aligned halo {HY}")
         self.lattice_shape = X, Y, Z = tuple(int(s) for s in lattice_shape)
@@ -449,6 +485,17 @@ class StreamingStencil:
         self.bx, self.by = int(bx), int(by)
         self.x_halo = bool(x_halo)
         self.y_halo = bool(y_halo)
+        #: y-slab output assembly: ``"concat"`` keeps every slab output
+        #: live until one concatenate (fastest — no extra writes);
+        #: ``"update"`` threads a dynamic-update-slice chain so each slab
+        #: buffer dies after its update — peak HBM drops by ~one full
+        #: output set at the cost of a zero-init write per output
+        #: (measured need: the 512^3 GW bf16-carry step misses the v5e
+        #: 16 GB by 183 MB under concat, with ~2 GB of live slab temps).
+        if assemble not in ("concat", "update"):
+            raise ValueError(f"assemble must be 'concat'/'update', "
+                             f"got {assemble!r}")
+        self.assemble = assemble
         self.interpret = _is_cpu() if interpret is None else interpret
         if not self.interpret and Z % LANE:
             raise ValueError(
@@ -503,12 +550,21 @@ class StreamingStencil:
             jax.ShapeDtypeStruct(self.out_defs[n] + (X, by, Z),
                                  self.dtypes.get(n, self.dtype))
             for n in self.out_defs]
-        nbx = X // bx
         for nt in self.sum_defs.values():
-            out_specs.append(pl.BlockSpec(
-                (nt, 1, 1), lambda i: (0, i, 0)))
+            # One (nt_pad8, LANE) accumulator tile REVISITED by every grid
+            # program (constant index map; the terms live in lane 0).
+            # Mosaic requires an output block's trailing two dims to be
+            # (8, 128)-aligned or equal to the array's (measured on v5e:
+            # a per-program (nt, 1, 1) block over (nt, nbx, 1) partials
+            # fails to compile), so per-program partial columns are out;
+            # the revisited block stays VMEM-resident across the
+            # sequential grid and each program adds its block sum —
+            # deterministic (TPU grids are sequential) and written back
+            # to HBM once.
+            ntp = -(-nt // HY) * HY
+            out_specs.append(pl.BlockSpec((ntp, LANE), lambda i: (0, 0)))
             out_shapes.append(
-                jax.ShapeDtypeStruct((nt, nbx, 1), self.dtype))
+                jax.ShapeDtypeStruct((ntp, LANE), self.dtype))
         return in_specs, out_specs, out_shapes
 
     def _unpack_refs(self, refs):
@@ -534,9 +590,31 @@ class StreamingStencil:
         nlat = len(self.out_defs)
         for n, ref in zip(self.out_defs, out_refs[:nlat]):
             ref[...] = outs[n].astype(ref.dtype)
+        i = pl.program_id(0)
         for n, ref in zip(self.sum_defs, out_refs[nlat:]):
-            ref[...] = outs[n].astype(ref.dtype).reshape(
-                self.sum_defs[n], 1, 1)
+            self._accumulate_sums(ref, outs[n], self.sum_defs[n], i)
+
+    @staticmethod
+    def _accumulate_sums(ref, terms, nt, i):
+        """Add this program's ``(nt,)`` block sums into the revisited
+        ``(nt_pad8, LANE)`` accumulator tile (terms in lane 0).
+        Zero-padding via explicit concatenates — ``jnp.pad`` recurses
+        infinitely in the Pallas TPU lowering (tests/test_tpu_lowering)."""
+        ntp, lanes = ref.shape
+        tile = terms.astype(ref.dtype).reshape(nt, 1)
+        if ntp > nt:
+            tile = jnp.concatenate(
+                [tile, jnp.zeros((ntp - nt, 1), ref.dtype)], axis=0)
+        tile = jnp.concatenate(
+            [tile, jnp.zeros((ntp, lanes - 1), ref.dtype)], axis=1)
+
+        @pl.when(i == 0)
+        def _():
+            ref[...] = tile
+
+        @pl.when(i > 0)
+        def _():
+            ref[...] = ref[...] + tile
 
     def _build(self, j):
         if self.x_halo:
@@ -615,6 +693,7 @@ class StreamingStencil:
                 for n, C in self.win_defs.items()
             ] + [pltpu.SemaphoreType.DMA((2,))],
             interpret=self.interpret,
+            compiler_params=_compiler_params(self.interpret),
         )
 
     def _build_xhalo(self, j):
@@ -627,10 +706,15 @@ class StreamingStencil:
         ypieces = self._y_pieces(j)
 
         def win_dmas(f_ref, win, sem, i, slot):
+            # int32 starts: under x64 a raw program_id product lowers as
+            # i64, which tpu.memref_slice rejects (test_tpu_lowering)
+            x0 = jnp.asarray(i, jnp.int32) * jnp.int32(bx)
+            # _rem also canonicalizes python-int slots to i32: a bare
+            # python index on the semaphore ref lowers as i64 under x64
             return [pltpu.make_async_copy(
-                f_ref.at[:, pl.ds(i * bx, bxw), pl.ds(sy0, n), :],
+                f_ref.at[:, pl.ds(x0, bxw), pl.ds(sy0, n), :],
                 win.at[:, pl.ds(slot * bxw, bxw), pl.ds(dy0, n), :],
-                sem.at[slot]) for sy0, dy0, n in ypieces]
+                sem.at[_rem(slot, 2)]) for sy0, dy0, n in ypieces]
 
         def kernel(*refs):
             f_refs, scalar_refs, extra_refs, out_refs, wins, sem = \
@@ -675,6 +759,7 @@ class StreamingStencil:
                 for n, C in self.win_defs.items()
             ] + [pltpu.SemaphoreType.DMA((2,))],
             interpret=self.interpret,
+            compiler_params=_compiler_params(self.interpret),
         )
 
     # -- invocation --------------------------------------------------------
@@ -695,11 +780,32 @@ class StreamingStencil:
         extra_args = [extras[n] for n in self.extra_defs]
         out_names = list(self.out_defs)
         nlat = len(out_names)
-        nby = self.lattice_shape[1] // self.by
+        X, Y, Z = self.lattice_shape
+        nby = Y // self.by
+
+        out = {}
+        if self.assemble == "update" and nby > 1:
+            # slab-at-a-time: each slab output is dead right after its
+            # dynamic_update_slice, so XLA can reuse one slab-sized temp
+            # instead of keeping all nby of them live for a concatenate
+            for n in out_names:
+                out[n] = jnp.zeros(
+                    self.out_defs[n] + (X, Y, Z),
+                    self.dtypes.get(n, self.dtype))
+            sums = dict.fromkeys(self.sum_defs, 0)
+            for j, call in enumerate(self._calls):
+                res = call(*win_args, *scalar_args, *extra_args)
+                for k, n in enumerate(out_names):
+                    yax = len(self.out_defs[n]) + 1
+                    out[n] = jax.lax.dynamic_update_slice_in_dim(
+                        out[n], res[k], j * self.by, axis=yax)
+                for k, n in enumerate(self.sum_defs):
+                    sums[n] = sums[n] + res[nlat + k][:self.sum_defs[n], 0]
+            out.update(sums)
+            return out
 
         slabs = [call(*win_args, *scalar_args, *extra_args)
                  for call in self._calls]
-        out = {}
         for k, n in enumerate(out_names):
             if nby == 1:
                 out[n] = slabs[0][k]
@@ -707,6 +813,9 @@ class StreamingStencil:
                 yax = len(self.out_defs[n]) + 1  # y of (*lead, X, by, Z)
                 out[n] = jnp.concatenate([s[k] for s in slabs], axis=yax)
         for k, n in enumerate(self.sum_defs):
-            # finish the reduction over grid programs and y-slabs
-            out[n] = sum(s[nlat + k].sum(axis=(1, 2)) for s in slabs)
+            # each slab's kernel already reduced over its grid programs
+            # (the revisited accumulator tile); finish over y-slabs and
+            # strip the (nt_pad8, LANE) tile padding
+            nt = self.sum_defs[n]
+            out[n] = sum(s[nlat + k][:nt, 0] for s in slabs)
         return out
