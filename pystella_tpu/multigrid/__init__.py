@@ -101,6 +101,15 @@ class FullApproximationScheme:
         self.restrictor = Restrictor(halo_shape=self.halo_shape)
         Interpolator = kwargs.pop("Interpolator", LinearInterpolation)
         self.interpolator = Interpolator(halo_shape=self.halo_shape)
+        #: error-norm materialization: deferred (device scalars converted
+        #: once at cycle end) keeps the device queue full — per-smooth
+        #: ``float()`` syncs serialized the whole cycle on the remote
+        #: (tunneled) TPU: 24 syncs x round-trip made a 512^3 V-cycle
+        #: ~5.2 s whichever smoother tier ran. Eager stays the default on
+        #: CPU, where deferring device scalars across a 3-axis virtual
+        #: mesh was measured to abort XLA's CPU runtime.
+        defer = kwargs.pop("defer_errors", None)
+        self._defer_errors = defer
         self._transfer_cache = {}
 
     # -- level geometry -----------------------------------------------------
@@ -196,18 +205,33 @@ class FullApproximationScheme:
 
     def smooth(self, levels, i, nu, unknowns, rhos, aux, decomp=None):
         """Relax level ``i`` for ``nu`` sweeps, recording errors before and
-        after (reference multigrid/__init__.py:285-302). The error record
-        syncs to host per smooth — deferring the device scalars to the
-        cycle end was measured to abort XLA's CPU runtime on 3-axis
-        meshes, so the norms are materialized eagerly."""
+        after (reference multigrid/__init__.py:285-302). On accelerator
+        backends the norms stay device scalars until the cycle end
+        (``__call__`` materializes them once) — eager per-smooth
+        ``float()`` syncs serialize the device queue, which costs a
+        round trip per norm on the tunneled TPU. On CPU they materialize
+        eagerly (deferring across a 3-axis virtual mesh was measured to
+        abort XLA's CPU runtime)."""
         solver = self.solver
-        errs1 = solver.get_error(levels[i], unknowns[i], rhos[i],
-                                 aux[i], decomp)
+        defer = (self._defer_errors if self._defer_errors is not None
+                 else jax.default_backend() != "cpu")
+        err_fn = solver.error_arrays if defer else solver.get_error
+        errs1 = err_fn(levels[i], unknowns[i], rhos[i], aux[i], decomp)
         unknowns[i] = solver.smooth(levels[i], unknowns[i], rhos[i],
                                     aux[i], nu, decomp)
-        errs2 = solver.get_error(levels[i], unknowns[i], rhos[i],
-                                 aux[i], decomp)
+        errs2 = err_fn(levels[i], unknowns[i], rhos[i], aux[i], decomp)
         return [(i, errs1), (i, errs2)]
+
+    @staticmethod
+    def _materialize_errors(errors):
+        """Convert any deferred device-scalar norms to floats via ONE
+        batched ``device_get`` of the whole record — per-scalar
+        ``float()`` fetches would still pay a device round trip each
+        (tens of them on the tunneled TPU), defeating the deferral."""
+        fetched = jax.device_get(errors)
+        return [(i, {n: [float(a), float(b)]
+                     for n, (a, b) in errs.items()})
+                for i, errs in fetched]
 
     # -- entry point --------------------------------------------------------
 
@@ -247,7 +271,7 @@ class FullApproximationScheme:
                 raise ValueError("consecutive levels must be spaced by one")
             errors += self.smooth(levels, i, nu, unknowns, rhos, aux, decomp)
             previous = i
-        return errors, unknowns[0]
+        return self._materialize_errors(errors), unknowns[0]
 
 
 class MultiGridSolver(FullApproximationScheme):
