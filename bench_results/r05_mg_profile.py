@@ -1,0 +1,123 @@
+"""Per-component multigrid timing at 512^3 f32 on one chip.
+
+The round-5 hardware session measured 5189 ms/FAS-V-cycle at 512^3 with
+the Pallas smoother tier engaged — barely better than the 5078 ms of the
+replaced XLA-smoother path — so the smoother is no longer the bottleneck
+and something else dominates. This times each V-cycle ingredient in
+isolation (jitted, synced, best-of-3) so the next optimization targets
+the measured cost, not the assumed one.
+
+Run on the TPU (single client!): ``python bench_results/r05_mg_profile.py``.
+"""
+
+import json
+import os
+import sys
+import time
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+N = int(os.environ.get("MG_PROFILE_N", "512"))
+
+
+def timed(label, fn, *args, reps=3):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)  # compile
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn_j(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({"op": label, "ms": round(best * 1e3, 2), "n": N}),
+          flush=True)
+    return out
+
+
+def main():
+    import pystella_tpu as ps
+    from pystella_tpu.multigrid import (
+        FullApproximationScheme, NewtonIterator)
+    from pystella_tpu.multigrid.transfer import (
+        CubicInterpolation, FullWeighting)
+
+    dtype = np.float32
+    grid_shape = (N, N, N)
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+    dx = 10.0 / N
+
+    f_sym = ps.Field("f")
+    problems = {f_sym: (ps.Field("lap_f") - f_sym + f_sym**3,
+                        ps.Field("rho"))}
+    solver = NewtonIterator(decomp, problems, halo_shape=1, omega=2 / 3,
+                            dtype=dtype)
+    mg = FullApproximationScheme(solver=solver, halo_shape=1)
+
+    rng = np.random.default_rng(11)
+    rho_np = rng.standard_normal(grid_shape).astype(dtype)
+    rho = decomp.shard(rho_np - rho_np.mean())
+    f = decomp.shard(0.1 * rng.standard_normal(grid_shape).astype(dtype))
+
+    # transfers at the finest level
+    fw = FullWeighting(halo_shape=1)
+    ci = CubicInterpolation(halo_shape=1)
+    coarse = timed("restrict-fullweight", lambda x: fw(x), f)
+    timed("interpolate-cubic", lambda x: ci(x), coarse)
+
+    # isolated smoother / residual / get_error at the finest level (the
+    # Pallas tier when the level admits it) — NOT jitted wrappers: the
+    # solver methods jit internally, so time them directly
+    depth = max(1, int(np.log2(N / 8)))
+    levels = mg._make_levels(decomp, grid_shape, dx, depth)
+    lvl = levels[0]
+    aux = {}
+
+    def timed_call(label, fn, reps=3):
+        out = fn()  # compile/warm
+        jax.block_until_ready(jax.tree.leaves(out))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(jax.tree.leaves(out))
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({"op": label, "ms": round(best * 1e3, 2),
+                          "n": N}), flush=True)
+
+    for nu in (1, 2, 25):
+        timed_call(f"smooth-nu{nu}-finest",
+                   lambda nu=nu: solver.smooth(
+                       lvl, {"f": f}, {"rho": rho}, aux, nu))
+    timed_call("residual-finest",
+               lambda: solver.residual(lvl, {"f": f}, {"rho": rho}, aux))
+    timed_call("get_error-finest",
+               lambda: solver.get_error(lvl, {"f": f}, {"rho": rho}, aux,
+                                        decomp))
+
+    # the full driver, end to end
+    t0 = time.perf_counter()
+    _, sol = mg(decomp, dx0=dx, f=f, rho=rho)
+    jax.block_until_ready(sol["f"])
+    print(json.dumps({"op": "vcycle-first(compile+run)",
+                      "s": round(time.perf_counter() - t0, 1), "n": N}),
+          flush=True)
+
+    for _ in range(2):
+        t0 = time.perf_counter()
+        _, sol = mg(decomp, dx0=dx, f=sol["f"], rho=rho)
+        jax.block_until_ready(sol["f"])
+        print(json.dumps({"op": "vcycle", "ms":
+                          round((time.perf_counter() - t0) * 1e3, 1),
+                          "n": N}), flush=True)
+
+
+if __name__ == "__main__":
+    print(json.dumps({"devices": [str(d) for d in jax.devices()]}),
+          flush=True)
+    main()

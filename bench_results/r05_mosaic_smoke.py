@@ -21,7 +21,25 @@ Run on the TPU: ``python bench_results/r05_mosaic_smoke.py``.
 
 import json
 import os
+import sys
 import time
+
+# Runnable from any cwd: the repo root (this file's parent's parent)
+# must be importable — ``python bench_results/r05_mosaic_smoke.py``
+# puts bench_results/ at sys.path[0], not the repo.
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+
+if os.environ.get("PYSTELLA_SMOKE_INTERPRET", "0") == "1":
+    # Interpret-mode validation must NEVER touch the tunnel: the
+    # container's sitecustomize register() forces jax_platforms to
+    # "axon,cpu" regardless of JAX_PLATFORMS, so pop the axon factory
+    # and pin cpu the way tests/common.py does (a stray interpret run
+    # once dialed the device mid-bench and contaminated the timings).
+    import jax as _jax
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    _jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 

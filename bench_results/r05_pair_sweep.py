@@ -1,0 +1,110 @@
+"""512^3 stage-pair blocking sweep with the raised scoped-VMEM limit.
+
+Round 3 measured the pair-fused 512^3 hot loop at (2,32) ~88.5 ms/step
+(1.52e9 site-updates/s) and found every bx>=4 or by>=128 blocking
+"failed Mosaic compile" — which round 5 traced to XLA's default 16 MB
+scoped-VMEM limit, not a hardware ceiling (the kernels now request
+``vmem_limit_bytes`` = PYSTELLA_VMEM_LIMIT_MB, default 100 MB, of the
+128 MB physical VMEM). This re-sweeps the pair blocking space including
+the formerly-rejected configs: bigger windows mean fewer DMA
+descriptors and better ring reuse, so one of them may beat the 1.41e9
+headline.
+
+Run on the TPU (single client): ``python bench_results/r05_pair_sweep.py``.
+Env: SWEEP_N (default 512), SWEEP_STEPS (default 6), SWEEP_CONFIGS
+("bx,by;bx,by;...").
+"""
+
+import json
+import os
+import sys
+import time
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+
+import numpy as np  # noqa: E402
+
+N = int(os.environ.get("SWEEP_N", "512"))
+NSTEPS = int(os.environ.get("SWEEP_STEPS", "6"))
+_default = "2,32;2,64;2,128;4,32;4,64;4,128;8,32;8,64;2,256;4,256"
+CONFIGS = [tuple(int(v) for v in c.split(","))
+           for c in os.environ.get("SWEEP_CONFIGS", _default).split(";")]
+
+
+def main():
+    import jax
+    import pystella_tpu as ps
+
+    grid_shape = (N, N, N)
+    dtype = np.float32
+    lattice = ps.Lattice(grid_shape, (5.0,) * 3, dtype=dtype)
+    dt = dtype(0.1 * min(lattice.dx))
+    decomp = ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+
+    def potential(f):
+        return 0.5 * 1.2e-2 * f[0]**2 + 0.125 * f[0]**2 * f[1]**2
+
+    sector = ps.ScalarSector(2, potential=potential)
+    rng = np.random.default_rng(7)
+    # host-side: each config shards a FRESH copy (the chunk donates its
+    # input buffers, so reusing one device state across configs fails
+    # with "Array has been deleted")
+    state_np = {
+        "f": 0.1 * rng.standard_normal((2,) + grid_shape).astype(dtype),
+        "dfdt": 0.01 * rng.standard_normal((2,) + grid_shape).astype(dtype),
+    }
+    args = {"a": dtype(1.0), "hubble": dtype(0.1)}
+    sites = float(N) ** 3
+    results = []
+
+    for bx, by in CONFIGS:
+        label = f"({bx},{by})"
+        try:
+            t0 = time.perf_counter()
+            stepper = ps.FusedScalarStepper(
+                sector, decomp, grid_shape, lattice.dx, 2, dtype=dtype,
+                dt=dt, pair_bx=bx, pair_by=by)
+
+            def chunk(st):
+                def body(carry, _):
+                    return stepper.step(carry, 0.0, dt, args), None
+                st, _ = jax.lax.scan(body, st, xs=None, length=NSTEPS)
+                return st
+
+            chunk_j = jax.jit(chunk, donate_argnums=0)
+            state = {k: decomp.shard(v) for k, v in state_np.items()}
+            state = chunk_j(state)  # compile + warm
+            jax.block_until_ready(state["f"])
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            state = chunk_j(state)
+            jax.block_until_ready(state["f"])
+            elapsed = time.perf_counter() - t0
+            ms = elapsed / NSTEPS * 1e3
+            ups = sites * NSTEPS / elapsed
+            results.append((ups, bx, by))
+            print(json.dumps({"block": label, "ms_per_step": round(ms, 2),
+                              "sites_per_s": f"{ups:.3e}",
+                              "compile_s": round(compile_s, 1)}),
+                  flush=True)
+            del state, chunk_j, stepper
+        except Exception as e:  # noqa: BLE001 - sweep survives bad configs
+            print(json.dumps({"block": label,
+                              "err": f"{type(e).__name__}: {str(e)[:200]}"}),
+                  flush=True)
+
+    if results:
+        best = max(results)
+        print(json.dumps({"best": f"({best[1]},{best[2]})",
+                          "sites_per_s": f"{best[0]:.4e}",
+                          "n": N}), flush=True)
+
+
+if __name__ == "__main__":
+    import jax
+    print(json.dumps({"devices": [str(d) for d in jax.devices()],
+                      "vmem_limit_mb": os.environ.get(
+                          "PYSTELLA_VMEM_LIMIT_MB", "100 (default)")}),
+          flush=True)
+    main()
