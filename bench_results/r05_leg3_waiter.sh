@@ -1,0 +1,31 @@
+#!/bin/bash
+# Leg-3 waiter: redial every 5 min (probes self-return UNAVAILABLE; never
+# killed), fire bench_results/r05_leg3.sh on the first successful dial.
+set -u
+cd /root/repo
+DEADLINE=$(( $(date -u +%s) + ${WAITER_BUDGET_S:-28800} ))  # default 8 h
+
+attempt=0
+while [ "$(date -u +%s)" -lt "$DEADLINE" ]; do
+  attempt=$((attempt+1))
+  echo "[leg3-waiter] attempt $attempt dialing at $(date -u)" >&2
+  if python - <<'EOF' 2> bench_results/r05_leg3_dial.err
+import jax
+devs = jax.devices()
+assert devs and devs[0].platform == "tpu", devs
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+assert float((x @ x).sum()) == 128.0 * 128 * 128
+EOF
+  then
+    echo "[leg3-waiter] tunnel OK on attempt $attempt; firing leg 3" >&2
+    bash bench_results/r05_leg3.sh \
+      > bench_results/r05_leg3.out 2> bench_results/r05_leg3.err
+    echo "[leg3-waiter] leg 3 complete rc=$? at $(date -u)" >&2
+    exit 0
+  fi
+  echo "[leg3-waiter] UNAVAILABLE at $(date -u)" >&2
+  sleep 300
+done
+echo "[leg3-waiter] deadline reached" >&2
+exit 1
