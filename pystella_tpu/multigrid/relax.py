@@ -313,11 +313,20 @@ class RelaxationBase:
             in_specs = (spec, spec,
                         (spec,) * len(aux_lat) + (P(),) * len(aux_scal),
                         P())
-            fn = jax.jit(decomp.shard_map(
-                run, in_specs, spec, check_vma=False))
+            core = decomp.shard_map(run, in_specs, spec, check_vma=False)
         else:
-            fn = jax.jit(run)
+            core = run
 
+        def entry(f_list, rho_list, aux_args, nu):
+            # stack/unstack INSIDE the jit: eager jnp.stack copies the
+            # full lattice per call (~40 copies per 512^3 V-cycle); here
+            # XLA fuses or aliases them into the kernel's input layout
+            fstack = jnp.stack(f_list)
+            rhostack = jnp.stack([jnp.asarray(r, dtype) for r in rho_list])
+            out = core(fstack, rhostack, aux_args, nu)
+            return [out[i] for i in range(len(f_list))]
+
+        fn = jax.jit(entry)
         self._compiled[key] = fn
         return fn
 
@@ -330,15 +339,13 @@ class RelaxationBase:
         fn = self._pallas_level(kind, level, decomp, dtype, aux_struct)
         if fn is None:
             return None  # cheap: no stacking before the feasibility gate
-        fstack = jnp.stack([fs[n] for n in names])
-        rhostack = jnp.stack(
-            [jnp.asarray(rhos[self.f_to_rho_dict[n]], dtype)
-             for n in names])
+        f_list = tuple(fs[n] for n in names)
+        rho_list = tuple(rhos[self.f_to_rho_dict[n]] for n in names)
         aux_args = tuple(aux[k] for k, kk in aux_struct
                          if kk == "lattice")
         aux_args += tuple(aux[k] for k, kk in aux_struct
                           if kk == "scalar")
-        out = fn(fstack, rhostack, aux_args, jnp.int32(nu))
+        out = fn(f_list, rho_list, aux_args, jnp.int32(nu))
         return {n: out[i] for i, n in enumerate(names)}
 
     def smooth(self, level, fs, rhos, aux, iterations, decomp=None):
