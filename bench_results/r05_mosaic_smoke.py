@@ -202,6 +202,40 @@ def bf16_carry():
     return {}
 
 
+def sums_tile():
+    """The revisited (nt_pad8, LANE) sum-accumulator tile and the
+    update-slice slab assembly vs numpy (the two round-5 kernel-layout
+    fixes; the per-program partial-column layout they replace was
+    rejected by Mosaic on hardware)."""
+    from pystella_tpu.ops.pallas_stencil import StreamingStencil
+    F, n = 2, 128
+    rng = np.random.default_rng(11)
+    f = jnp.asarray(rng.standard_normal((F, n, n, n)), jnp.float32)
+
+    def body(taps, extras, scalars):
+        fv = taps()
+        sums = jnp.stack([jnp.sum(fv[i] * fv[i]) for i in range(F)]
+                         + [jnp.sum(fv[0] * fv[1])])
+        return {"out": fv * 2.0, "sums": sums}
+
+    outs = {}
+    for mode in ("concat", "update"):
+        st = StreamingStencil((n, n, n), F, 2, body, {"out": (F,)},
+                              dtype=jnp.float32, sum_defs={"sums": F + 1},
+                              interpret=INTERPRET, assemble=mode)
+        outs[mode] = st(f)
+    fn = np.asarray(f, np.float64)
+    ref = np.array([(fn[0]**2).sum(), (fn[1]**2).sum(),
+                    (fn[0] * fn[1]).sum()])
+    rel = {m: float(np.max(np.abs(np.asarray(o["sums"], np.float64) - ref)
+                           / np.abs(ref)))
+           for m, o in outs.items()}
+    assert max(rel.values()) < 1e-4, rel
+    assert np.array_equal(np.asarray(outs["concat"]["out"]),
+                          np.asarray(outs["update"]["out"]))
+    return {"sum_maxrel": rel}
+
+
 def main():
     print(json.dumps({"devices": [str(d) for d in jax.devices()]}),
           flush=True)
@@ -211,6 +245,7 @@ def main():
     check("yhalo-window-128", yhalo_window)
     check("mg-smoother-128", mg_smoother)
     check("bf16-carry-128", bf16_carry)
+    check("sums-tile-update-128", sums_tile)
     nok = sum(1 for r in RESULTS.values() if r["ok"])
     print(json.dumps({"summary": f"{nok}/{len(RESULTS)} ok"}),
           flush=True)
