@@ -13,7 +13,12 @@ from pystella_tpu.utils.checkpoint import Checkpointer
 
 @pytest.fixture
 def decomp():
-    return ps.DomainDecomposition((2, 2, 1), devices=jax.devices()[:4])
+    # (2,2,1) on the virtual 8-device CPU mesh; on a single-chip TPU the
+    # same round-trip semantics hold on a (1,1,1) mesh (the 4-device
+    # request was a setup ERROR there, not a meaningful skip)
+    if len(jax.devices()) >= 4:
+        return ps.DomainDecomposition((2, 2, 1), devices=jax.devices()[:4])
+    return ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
 
 
 def _state(decomp, seed=0):
