@@ -20,6 +20,16 @@ import os
 # kernels then execute Mosaic-compiled rather than in interpret mode —
 # the on-device parity run of tests/test_pallas_stencil.py and
 # tests/test_fused.py).
+#
+# TPU caveat (measured, round-5 hardware session): tests that assert
+# f64-precision tolerances (derivs eigenvalues at 1e-11, fused parity at
+# 1e-12, fourier round-trips, ...) are EXPECTED to fail on TPU backends,
+# which demote 64-bit math — that is a precision property, not a bug.
+# Movement-only and mesh-setup tests are TPU-aware (realized-dtype
+# comparisons, single-chip fallbacks). The designed compiled-coverage
+# path on hardware is bench.py's parity configs +
+# bench_results/r05_mosaic_smoke.py (f32, per-feature verdicts) +
+# tests/test_tpu_lowering.py (Pallas TPU lowering checks, runs on CPU).
 # PYSTELLA_TEST_PLATFORM alone governs the suite: ambient
 # PYSTELLA_BENCH_PLATFORM (the benchmark scripts' knob) must not flip
 # pytest onto the tunnel, so it is overwritten unconditionally.
