@@ -62,6 +62,13 @@ def _field_name(f):
     raise TypeError(f"lhs_dict keys must be Field or str, got {type(f)}")
 
 
+#: jitted (Linf, L2) residual norms — one executable shared by every
+#: solver instance; the four eager norm ops per unknown per smooth would
+#: each be a separate device dispatch (~15 ms uncached on a tunneled TPU)
+_residual_norms = jax.jit(lambda rn: (jnp.max(jnp.abs(rn)),
+                                      jnp.sqrt(jnp.mean(rn * rn))))
+
+
 class RelaxationBase:
     """Base class for relaxation solvers (reference relax.py:36-320).
 
@@ -389,8 +396,7 @@ class RelaxationBase:
         drivers can record errors without serializing the device queue
         (they convert once at the end; multigrid/__init__.py)."""
         r = self.residual(level, fs, rhos, aux, decomp)
-        return {n: [jnp.max(jnp.abs(rn)), jnp.sqrt(jnp.mean(rn * rn))]
-                for n, rn in r.items()}
+        return {n: list(_residual_norms(rn)) for n, rn in r.items()}
 
     def get_error(self, level, fs, rhos, aux, decomp=None):
         """L-infinity and L2 norms of the residual per unknown (reference
