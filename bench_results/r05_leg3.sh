@@ -1,37 +1,39 @@
 #!/bin/bash
 # Round-5 hardware leg 3: re-validation AFTER the fixes the first
 # session surfaced (scoped-VMEM limit request, Mosaic-legal sum-output
-# accumulator, update-slice slab assembly for the 512^3 GW config).
-# Also re-measures the three preheat configs cleanly: the first
-# session's 128/256/512 numbers were contaminated by a concurrent
-# interpret-mode probe sharing the chip (bench_results/r05_README.md).
+# accumulator, update-slice slab assembly, V-cycle dispatch collapse +
+# deferred error norms). Also re-measures the three preheat configs
+# cleanly: the first session's numbers were contaminated by a
+# concurrent probe sharing the chip (bench_results/r05_README.md).
+# Ordered most-important-first in case the tunnel window is short:
+# the fresh bench headline > multigrid profile > pair sweep > smoke.
 # Single-client discipline: run ONLY when no other process holds the
 # tunnel; never kill a dialing client.
 set -u
 cd /root/repo
 
-echo "[r05-leg3] 0: Mosaic feature smoke (compiled) $(date -u)" >&2
-timeout 2400 python bench_results/r05_mosaic_smoke.py \
-  > bench_results/r05_mosaic_smoke.out 2> bench_results/r05_mosaic_smoke.err
-echo "rc=$?" >> bench_results/r05_mosaic_smoke.err
-cat bench_results/r05_mosaic_smoke.out >&2
-
-echo "[r05-leg3] 1: fresh bench, all configs, clean chip $(date -u)" >&2
+echo "[r05-leg3] 0: fresh bench, all configs, clean chip $(date -u)" >&2
 BENCH_TOTAL_BUDGET=3600 timeout 3700 python bench.py \
   > bench_results/r05_bench_leg3.out 2> bench_results/r05_bench_leg3.err
 echo "rc=$?" >> bench_results/r05_bench_leg3.err
 tail -4 bench_results/r05_bench_leg3.out >&2
 
-echo "[r05-leg3] 2: multigrid component profile $(date -u)" >&2
+echo "[r05-leg3] 1: multigrid component profile $(date -u)" >&2
 timeout 1800 python bench_results/r05_mg_profile.py \
   > bench_results/r05_mg_profile.out 2> bench_results/r05_mg_profile.err
 echo "rc=$?" >> bench_results/r05_mg_profile.err
 cat bench_results/r05_mg_profile.out >&2
 
-echo "[r05-leg3] 3: 512^3 pair-blocking sweep (raised VMEM limit) $(date -u)" >&2
+echo "[r05-leg3] 2: 512^3 pair-blocking sweep (raised VMEM limit) $(date -u)" >&2
 timeout 3000 python bench_results/r05_pair_sweep.py \
   > bench_results/r05_pair_sweep.out 2> bench_results/r05_pair_sweep.err
 echo "rc=$?" >> bench_results/r05_pair_sweep.err
 cat bench_results/r05_pair_sweep.out >&2
+
+echo "[r05-leg3] 3: Mosaic feature smoke (compiled) $(date -u)" >&2
+timeout 2400 python bench_results/r05_mosaic_smoke.py \
+  > bench_results/r05_mosaic_smoke.out 2> bench_results/r05_mosaic_smoke.err
+echo "rc=$?" >> bench_results/r05_mosaic_smoke.err
+cat bench_results/r05_mosaic_smoke.out >&2
 
 echo "[r05-leg3] done $(date -u)" >&2
