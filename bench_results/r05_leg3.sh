@@ -12,6 +12,21 @@
 set -u
 cd /root/repo
 
+# Time-adaptive: if the tunnel returns with <75 min of round left
+# (driver ends ~15:50Z), capture ONLY the 512^3 headline + wave
+# resident proof instead of the full matrix.
+NOW=$(date -u +%s)
+CUTOFF=$(date -u -d "2026-07-31 14:30" +%s 2>/dev/null || echo 0)
+if [ "$NOW" -gt "$CUTOFF" ]; then
+  echo "[r05-leg3] LATE WINDOW: 512^3-headline-only bench $(date -u)" >&2
+  BENCH_GRIDS=512 BENCH_TOTAL_BUDGET=2400 timeout 2500 python bench.py \
+    > bench_results/r05_bench_leg3.out 2> bench_results/r05_bench_leg3.err
+  echo "rc=$?" >> bench_results/r05_bench_leg3.err
+  tail -4 bench_results/r05_bench_leg3.out >&2
+  echo "[r05-leg3] done (late window) $(date -u)" >&2
+  exit 0
+fi
+
 echo "[r05-leg3] 0: fresh bench, all configs, clean chip $(date -u)" >&2
 BENCH_TOTAL_BUDGET=3600 timeout 3700 python bench.py \
   > bench_results/r05_bench_leg3.out 2> bench_results/r05_bench_leg3.err
