@@ -63,6 +63,41 @@ T0 = time.time()
 CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_results", "tpu_lines.jsonl")
 
+#: structured run-event log (JSONL) alongside the human-readable [bench]
+#: stderr lines — the pystella_tpu.obs.events schema. The ORCHESTRATOR
+#: never imports jax, so it cannot import the pystella_tpu package;
+#: instead obs_event() loads obs/events.py by FILE (the module itself is
+#: stdlib-only), sharing the one schema definition. Payload subprocesses
+#: point PYSTELLA_EVENT_LOG at the same file, so framework-internal
+#: events (compile, fallbacks, mg_cycle, device_memory) interleave with
+#: the orchestrator's lifecycle events in one greppable record.
+#: Override with BENCH_EVENT_LOG.
+EVENTS_PATH = os.environ.get("BENCH_EVENT_LOG") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "bench_results", "run_events.jsonl")
+
+_EVENTS_LOG = None
+
+
+def obs_event(kind, step=None, **data):
+    """Append one run event through the shared obs.events writer.
+    Best effort — telemetry must never kill a bench run."""
+    global _EVENTS_LOG
+    try:
+        if _EVENTS_LOG is None:
+            import importlib.util
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "pystella_tpu", "obs", "events.py")
+            spec = importlib.util.spec_from_file_location(
+                "_bench_obs_events", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _EVENTS_LOG = mod.EventLog(EVENTS_PATH)
+        _EVENTS_LOG.emit(kind, step=step, **data)
+    except Exception as e:
+        hb(f"event append failed: {e}")
+
 
 def cache_append(rec):
     """Persist one captured hardware JSON line (adds a timestamp)."""
@@ -121,6 +156,8 @@ def hb(msg):
 def emit(metric, value, unit, vs_baseline):
     print(json.dumps({"metric": metric, "value": value, "unit": unit,
                       "vs_baseline": vs_baseline}), flush=True)
+    obs_event("bench_metric", metric=metric, value=value, unit=unit,
+              vs_baseline=vs_baseline)
 
 
 def bounded(fn, timeout, label):
@@ -260,8 +297,11 @@ def run_preheat(n, nsteps=10, dtype=np.float32, fused="auto"):
         chunk = jax.jit(chunk, donate_argnums=0)
 
     hb(f"{n}^3 ({label}): compiling + warmup (one {nsteps}-step chunk)")
+    t_compile = time.perf_counter()
     state = chunk(state)
     sync(state)
+    obs_event("bench_warmup", config=f"preheat-{n}^3 ({label})",
+              seconds=round(time.perf_counter() - t_compile, 3))
 
     hb(f"{n}^3 ({label}): timing one {nsteps}-step chunk")
     start = time.perf_counter()
@@ -400,6 +440,18 @@ def run_gw_spectra(n=256, nreps=5):
     return (time.perf_counter() - start) / nreps * 1e3
 
 
+def auto_assemble(decomp, grid_shape):
+    """Default y-slab assembly mode for the GW stepper: 'update' only
+    when the PER-DEVICE block is at the single-chip HBM edge. The
+    threshold is local volume, not global: the 512^3 single-chip config
+    misses 16 GB by 183 MB under the default concat assembly (measured;
+    ~2 GB of live slab temps the update-slice chain frees), but a
+    multi-chip decomp whose per-chip state fits comfortably should not
+    pay update's extra zero-init write per output."""
+    local_sites = int(np.prod(decomp.rank_shape(grid_shape)))
+    return "update" if local_sites >= 512**3 else "concat"
+
+
 def build_gw_step(grid_shape, dtype=np.float32, decomp=None,
                   carry_dtype=None, assemble=None):
     """Construct the full scalar+GW preheating system (the one model that
@@ -423,10 +475,7 @@ def build_gw_step(grid_shape, dtype=np.float32, decomp=None,
     gw = ps.TensorPerturbationSector([sector])
     kw = {} if carry_dtype is None else {"carry_dtype": carry_dtype}
     if assemble is None:
-        # the 512^3 single-chip config misses 16 GB by 183 MB with the
-        # default concat slab assembly (measured; ~2 GB of live slab
-        # temps) — the update-slice chain frees them
-        assemble = "update" if int(np.prod(grid_shape)) >= 512**3 else "concat"
+        assemble = auto_assemble(decomp, grid_shape)
     stepper = ps.FusedPreheatStepper(sector, gw, decomp, grid_shape,
                                      lattice.dx, 2, dtype=dtype, dt=dt,
                                      assemble=assemble, **kw)
@@ -674,6 +723,11 @@ def payload(platform_wanted):
     budget = float(os.environ.get("BENCH_CONFIG_BUDGET", "300"))
     extras = os.environ.get("BENCH_EXTRAS", "1") != "0"
 
+    # framework-internal obs events (compile reports, tier fallbacks,
+    # mg_cycle, device_memory) land in the same JSONL record as the
+    # orchestrator's lifecycle events
+    os.environ.setdefault("PYSTELLA_EVENT_LOG", EVENTS_PATH)
+
     if platform_wanted == "cpu":
         from __graft_entry__ import _drop_remote_tpu_plugin
         _drop_remote_tpu_plugin()
@@ -696,6 +750,10 @@ def payload(platform_wanted):
     x = jnp.ones((128, 128), np.float32)
     bounded(lambda: sync(x @ x), budget, "smoke-matmul")
     hb("payload: smoke matmul OK")
+    obs_event("payload_device_up", platform=platform,
+              ndevices=len(devices))
+    from pystella_tpu.obs.memory import device_memory_report
+    device_memory_report(label="post-dial")  # no-op on stat-less CPU
 
     if platform == "cpu":
         grids = [g for g in grids if g <= 128] or [min(grids)]
@@ -710,6 +768,8 @@ def payload(platform_wanted):
             ups, ms = bounded(lambda n=n: run_preheat(n), budget, label)
         except Exception as e:
             hb(f"{label} FAILED: {type(e).__name__}: {e}")
+            obs_event("bench_config_failed", config=label,
+                      error=f"{type(e).__name__}: {e}")
             traceback.print_exc()
             continue
         emit(f"site-updates/sec/chip ({n}^3 preheating, RK54+lap4{suffix})",
@@ -788,6 +848,8 @@ def payload(platform_wanted):
                 val = bounded(fn, cfg_budget, label)
             except Exception as e:
                 hb(f"{label} FAILED: {type(e).__name__}: {e}")
+                obs_event("bench_config_failed", config=label,
+                          error=f"{type(e).__name__}: {e}")
                 traceback.print_exc()
                 continue
             emit(label, val, unit, val / base if base else None)
@@ -881,6 +943,8 @@ def main():
     hb(f"orchestrator: total budget {total_budget:.0f}s "
        f"(cpu fallback reserve {cpu_reserve:.0f}s, "
        f"{len(cached)} cached hardware line(s))")
+    obs_event("orchestrator_start", total_budget=total_budget,
+              cached_lines=len(cached), force_cpu=force_cpu)
 
     # previously-captured hardware lines FIRST (clearly labeled): even a
     # total tunnel outage then relays a real prior hardware number, and
@@ -923,6 +987,8 @@ def main():
         t_attempt = time.time()
         relayed, rc = run_payload("tpu", remaining, cache=True)
         got_tpu += relayed
+        obs_event("tpu_attempt", attempt=attempt, relayed=relayed, rc=rc,
+                  seconds=round(time.time() - t_attempt, 1))
         if relayed and rc == 0:
             break
         if relayed:
@@ -968,8 +1034,11 @@ def main():
             remaining = max(60.0, total_budget - (time.time() - T0))
             relayed, rc = run_payload("cpu", remaining)
             if relayed == 0 and got_insurance == 0:
+                obs_event("orchestrator_done", outcome="no_result")
                 raise SystemExit(
                     "no benchmark result captured on any platform")
+    obs_event("orchestrator_done",
+              outcome="tpu" if got_tpu else "fallback")
     hb("orchestrator done")
 
 

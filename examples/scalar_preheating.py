@@ -73,11 +73,19 @@ parser.add_argument("--checkpoint-dir", type=str, default=None,
                     help="enable checkpoint/resume under this directory")
 parser.add_argument("--checkpoint-interval", type=int, default=100,
                     metavar="STEPS")
+parser.add_argument("--event-log", type=str, default=None,
+                    metavar="PATH", help="structured JSONL run-event log"
+                    " (doc/observability.md); PYSTELLA_EVENT_LOG also"
+                    " works")
 
 
 def main(argv=None):
     import jax
     p = parser.parse_args(argv)
+    if p.event_log is not None:
+        # HealthMonitor divergences, checkpoint saves/restores, and
+        # StepTimer reports then all land in one greppable record
+        ps.obs.configure(p.event_log)
     p.grid_shape = tuple(p.grid_shape)
     p.proc_shape = tuple(p.proc_shape)
     p.box_dim = tuple(p.box_dim)
@@ -277,6 +285,10 @@ def main(argv=None):
         print("Time evolution beginning")
         print("time\t", "scale factor", "ms/step\t", "steps/second",
               sep="\t")
+    ps.obs.emit("run_start", step=step_count, t=t, a=float(expand.a),
+                grid_shape=p.grid_shape, proc_shape=p.proc_shape,
+                gravitational_waves=p.gravitational_waves,
+                chunk_steps=p.chunk_steps)
 
     steptimer = ps.StepTimer(report_every=30.0)
     # check at least as often as checkpoints are written so a diverged
@@ -377,6 +389,8 @@ def main(argv=None):
     if decomp.rank == 0:
         print("Simulation complete")
         print(f"final constraint: {constraint:.16e}")
+    ps.obs.emit("run_complete", step=step_count, t=t,
+                a=float(expand.a), constraint=float(constraint))
     return constraint
 
 

@@ -40,6 +40,7 @@ from pystella_tpu.models import (
     Sector, ScalarSector, TensorPerturbationSector, tensor_index,
     get_rho_and_p, Expansion,
 )
+from pystella_tpu import obs
 from pystella_tpu.utils import (Checkpointer, HealthMonitor,
     SimulationDiverged, OutputFile, ShardedSnapshot, StepTimer, timer,
     trace, advise_shapes)
@@ -96,7 +97,7 @@ __all__ = [
     "SpectralCollocator", "SpectralPoissonSolver",
     "Sector", "ScalarSector", "TensorPerturbationSector", "tensor_index",
     "get_rho_and_p", "Expansion", "OutputFile", "ShardedSnapshot",
-    "timer", "Checkpointer",
+    "timer", "Checkpointer", "obs",
     "HealthMonitor", "SimulationDiverged", "StepTimer", "trace",
     "Stepper", "RungeKuttaStepper", "LowStorageRKStepper", "compile_rhs_dict",
     "RungeKutta4", "RungeKutta3Heun", "RungeKutta3Nystrom",

@@ -1,0 +1,84 @@
+"""Compatibility shims across the jax releases the deployment images ship.
+
+The framework targets current jax (explicit-sharding meshes,
+``jax.shard_map``, ``jax.sharding.reshard``, ``pltpu.CompilerParams``),
+but serving images pin older runtimes — the oldest supported is the
+0.4.x line, where those names either do not exist or live elsewhere.
+Every version-sensitive import goes through this module so the rest of
+the codebase is written against one surface:
+
+- :data:`AxisType` / :func:`mesh_axis_types` — explicit-sharding axis
+  types when the runtime has them, else ``None`` (meshes are then built
+  without ``axis_types`` and the pencil FFT's resharding goes through
+  ``with_sharding_constraint``, see :func:`reshard`).
+- :func:`shard_map` — ``jax.shard_map`` (new) or
+  ``jax.experimental.shard_map.shard_map`` (old), with the
+  ``check_vma``/``check_rep`` keyword rename papered over.
+- :func:`reshard` — ``jax.sharding.reshard`` (new) or
+  ``jax.lax.with_sharding_constraint`` (old). Both accept a concrete
+  ``NamedSharding`` (mesh embedded) and force a layout change inside
+  jit, which is the only way the framework calls it.
+- :func:`tpu_compiler_params` — ``pltpu.CompilerParams`` (new name) or
+  ``pltpu.TPUCompilerParams`` (old name).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["AxisType", "mesh_axis_types", "shard_map", "reshard",
+           "tpu_compiler_params"]
+
+try:
+    from jax.sharding import AxisType
+except ImportError:  # jax < 0.5: no explicit-sharding axis types
+    AxisType = None
+
+try:
+    from jax.sharding import reshard
+    _HAS_RESHARD = True
+except ImportError:  # jax < 0.6: constraint-based resharding
+    from jax.lax import with_sharding_constraint as reshard  # noqa: F401
+    _HAS_RESHARD = False
+
+
+def mesh_axis_types(n_axes, explicit):
+    """``axis_types`` kwargs for ``Mesh(...)``: explicit (or auto) types
+    on runtimes that support them, empty kwargs otherwise. Explicit axes
+    additionally require the declarative ``reshard`` — a runtime with
+    ``AxisType`` but no ``reshard`` (the 0.5 window) would pair explicit
+    meshes with the ``with_sharding_constraint`` fallback, which is not
+    valid across explicitly-typed axes; such runtimes get a plain
+    mesh."""
+    if AxisType is None or not _HAS_RESHARD:
+        return {}
+    kind = AxisType.Explicit if explicit else AxisType.Auto
+    return {"axis_types": (kind,) * n_axes}
+
+
+if hasattr(jax, "shard_map"):
+    def shard_map(fn, mesh, in_specs, out_specs, check_vma=None,
+                  **kwargs):
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(fn, mesh, in_specs, out_specs, check_vma=None,
+                  **kwargs):
+        # the old API calls the same replication check ``check_rep``
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+
+def tpu_compiler_params(**kwargs):
+    """Construct Mosaic compiler params under either API name."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

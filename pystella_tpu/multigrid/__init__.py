@@ -25,6 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import metrics as _metrics
+from pystella_tpu.obs.scope import trace_scope
 from pystella_tpu.multigrid.relax import (
     LevelSpec, RelaxationBase, JacobiIterator, NewtonIterator)
 from pystella_tpu.multigrid.transfer import (
@@ -85,6 +88,17 @@ class FullApproximationScheme:
     :arg halo_shape: stencil/transfer halo width; defaults to the solver's.
     :arg Restrictor: defaults to :class:`FullWeighting`.
     :arg Interpolator: defaults to :class:`LinearInterpolation`.
+    :arg defer_errors: error-norm materialization. ``True`` keeps the
+        per-smooth residual norms as device scalars until the cycle end
+        (one batched fetch — eager per-smooth ``float()`` syncs
+        serialized the whole V-cycle on the tunneled TPU); ``False``
+        materializes eagerly. Default ``None`` auto-selects: deferred on
+        accelerator backends, eager on CPU (where deferring across a
+        3-axis virtual mesh was measured to abort XLA's CPU runtime).
+
+    Unknown keyword arguments raise ``TypeError`` (a misspelled
+    ``defer_errors`` silently changing sync behavior is exactly the kind
+    of contamination the event log exists to catch).
 
     Call with the fine decomposition, the fine grid spacing, an optional
     cycle, and all arrays by keyword; returns ``(errors, unknowns)`` where
@@ -110,6 +124,10 @@ class FullApproximationScheme:
         #: mesh was measured to abort XLA's CPU runtime.
         defer = kwargs.pop("defer_errors", None)
         self._defer_errors = defer
+        if kwargs:
+            raise TypeError(
+                f"{type(self).__name__}() got unexpected keyword "
+                f"argument(s): {', '.join(sorted(kwargs))}")
         self._transfer_cache = {}
 
     # -- level geometry -----------------------------------------------------
@@ -262,19 +280,30 @@ class FullApproximationScheme:
         unknowns = {0: dict(unknowns0)}
         rhos = {0: dict(rhos0)}
 
-        errors = self.smooth(levels, 0, cycle[0][1], unknowns, rhos, aux,
-                             decomp)
-        previous = 0
-        for i, nu in cycle[1:]:
-            if i == previous + 1:
-                self.transfer_down(decomp, levels, i, unknowns, rhos, aux)
-            elif i == previous - 1:
-                self.transfer_up(decomp, levels, i, unknowns, rhos, aux)
-            else:
-                raise ValueError("consecutive levels must be spaced by one")
-            errors += self.smooth(levels, i, nu, unknowns, rhos, aux, decomp)
-            previous = i
-        return self._materialize_errors(errors), unknowns[0]
+        with _metrics.timer("mg_cycle_s"), trace_scope("mg_cycle"):
+            errors = self.smooth(levels, 0, cycle[0][1], unknowns, rhos,
+                                 aux, decomp)
+            previous = 0
+            for i, nu in cycle[1:]:
+                if i == previous + 1:
+                    self.transfer_down(decomp, levels, i, unknowns, rhos,
+                                       aux)
+                elif i == previous - 1:
+                    self.transfer_up(decomp, levels, i, unknowns, rhos,
+                                     aux)
+                else:
+                    raise ValueError(
+                        "consecutive levels must be spaced by one")
+                errors += self.smooth(levels, i, nu, unknowns, rhos, aux,
+                                      decomp)
+                previous = i
+            materialized = self._materialize_errors(errors)
+        _metrics.counter("mg_cycles").inc()
+        _metrics.counter("mg_smooths").inc(len(cycle))
+        final = materialized[-1][1] if materialized else {}
+        _events.emit("mg_cycle", depth=depth, grid_shape=grid_shape,
+                     nsmooths=len(cycle), final_errors=final)
+        return materialized, unknowns[0]
 
 
 class MultiGridSolver(FullApproximationScheme):

@@ -34,6 +34,7 @@ from jax import lax
 
 from pystella_tpu import field as _field
 from pystella_tpu.field import Field, Var, diff, evaluate
+from pystella_tpu.obs.scope import trace_scope
 from pystella_tpu.ops.derivs import (
     SecondCenteredDifference, _apply_centered, _shifted)
 from pystella_tpu.multigrid.transfer import periodic_pad
@@ -359,22 +360,24 @@ class RelaxationBase:
         """Run ``iterations`` relaxation sweeps; returns updated unknowns."""
         decomp = decomp if decomp is not None else self.decomp
         fs, rhos, aux = self._cast(fs), self._cast(rhos), self._cast(aux)
-        res = self._try_pallas("smooth", level, fs, rhos, aux, decomp,
-                               nu=int(iterations))
-        if res is not None:
-            return res
-        return self._get_compiled("smooth", level, int(iterations), decomp)(
-            fs, rhos, aux)
+        with trace_scope("mg_smooth"):
+            res = self._try_pallas("smooth", level, fs, rhos, aux, decomp,
+                                   nu=int(iterations))
+            if res is not None:
+                return res
+            return self._get_compiled(
+                "smooth", level, int(iterations), decomp)(fs, rhos, aux)
 
     def residual(self, level, fs, rhos, aux, decomp=None):
         """``rho - L(f)`` per unknown (reference relax.py:216-223)."""
         decomp = decomp if decomp is not None else self.decomp
         fs, rhos, aux = self._cast(fs), self._cast(rhos), self._cast(aux)
-        res = self._try_pallas("residual", level, fs, rhos, aux, decomp)
-        if res is not None:
-            return res
-        return self._get_compiled("residual", level, None, decomp)(
-            fs, rhos, aux)
+        with trace_scope("mg_residual"):
+            res = self._try_pallas("residual", level, fs, rhos, aux, decomp)
+            if res is not None:
+                return res
+            return self._get_compiled("residual", level, None, decomp)(
+                fs, rhos, aux)
 
     def tau_rhs(self, level, fs, restricted_resid, aux, decomp=None):
         """Coarse-level rho with FAS tau-correction. Takes the Pallas

@@ -1,0 +1,48 @@
+"""Unified telemetry: run events, metrics, trace scopes, memory reports.
+
+One subsystem behind the pieces that grew up scattered (``utils/monitor``,
+``utils/profiling``, ``bench.py``'s hand-rolled orchestrator prints):
+
+- :mod:`pystella_tpu.obs.events` — a structured JSONL run-event log
+  (wall + monotonic timestamps, host id, step, event kind, payload) that
+  drivers, :class:`~pystella_tpu.HealthMonitor`, checkpointing, the
+  multigrid driver, and ``bench.py`` all emit through. Outage and
+  contamination forensics become ``grep``s over one file instead of
+  archaeology on interleaved stderr.
+- :mod:`pystella_tpu.obs.metrics` — a lightweight registry of counters /
+  gauges / timers (steps taken, halo exchanges, V-cycles, compile
+  events, ms/step EMA, site-updates/s) with a multihost-aware
+  :meth:`~pystella_tpu.obs.metrics.MetricsRegistry.aggregate` so host 0
+  reports fleet-wide numbers.
+- :mod:`pystella_tpu.obs.scope` — ``jax.named_scope`` +
+  ``jax.profiler.TraceAnnotation`` wrappers threaded through the hot
+  paths, so Perfetto/TensorBoard traces show semantically named regions
+  (RK stages, halo exchanges, stencil kernels, multigrid smoothers)
+  instead of raw XLA op soup.
+- :mod:`pystella_tpu.obs.memory` — compile-time and HBM
+  instrumentation: per-computation compile seconds and
+  ``memory_analysis()`` byte counts recorded into the event log, plus
+  live device-memory reports (the evidence that catches an HBM
+  overshoot *before* Mosaic or the allocator rejects it).
+
+See ``doc/observability.md`` for the event schema and driver recipes.
+"""
+
+from pystella_tpu.obs.events import (
+    EventLog, configure, emit, get_log, read_events)
+from pystella_tpu.obs.metrics import (
+    Counter, Gauge, MetricsRegistry, Timer, counter, gauge, registry, timer)
+from pystella_tpu.obs.scope import (
+    has_scope, lowered_scopes, trace_scope, traced)
+from pystella_tpu.obs.memory import (
+    CompileRecord, compile_with_report, device_memory_report,
+    device_memory_stats)
+
+__all__ = [
+    "EventLog", "configure", "emit", "get_log", "read_events",
+    "Counter", "Gauge", "Timer", "MetricsRegistry",
+    "counter", "gauge", "timer", "registry",
+    "trace_scope", "traced", "lowered_scopes", "has_scope",
+    "CompileRecord", "compile_with_report",
+    "device_memory_report", "device_memory_stats",
+]

@@ -1,0 +1,215 @@
+"""Structured JSONL run-event log.
+
+Every event is one JSON object per line, appended and flushed
+immediately so a killed run keeps everything emitted before the kill
+(the property that saved round 4's bench record; ``bench.py``'s line
+cache pioneered the pattern). Schema (version 1):
+
+===========  ======================================================
+key          meaning
+===========  ======================================================
+``v``        schema version (``1``)
+``ts``       wall-clock POSIX seconds (cross-host correlation)
+``mono``     ``time.monotonic()`` seconds (robust to clock steps;
+             durations within one process difference correctly)
+``host``     jax process index (``0`` outside a jax process)
+``kind``     event kind, a short snake_case string (``"compile"``,
+             ``"diverged"``, ``"checkpoint_save"``, ``"mg_cycle"``,
+             ``"bench_metric"``, ...)
+``step``     simulation step number, or ``null``
+``data``     kind-specific payload (flat, JSON-safe)
+===========  ======================================================
+
+This module is importable without jax (the ``bench.py`` orchestrator
+process never touches jax by design); the host id is resolved lazily
+from an already-imported jax only.
+
+Usage::
+
+    from pystella_tpu import obs
+    obs.configure("run_events.jsonl")       # or env PYSTELLA_EVENT_LOG
+    obs.emit("checkpoint_save", step=1200, path="ckpts/1200")
+    ...
+    for ev in obs.read_events("run_events.jsonl"):
+        ...
+
+With no configured path (and no ``PYSTELLA_EVENT_LOG``) the default log
+is a disabled sink and :func:`emit` costs one attribute check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+__all__ = ["EventLog", "configure", "emit", "get_log", "read_events",
+           "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = 1
+
+
+def _host_id():
+    """This process's index in the multi-controller cluster. Resolved
+    from jax only when jax is already imported — the bench orchestrator
+    (and any other jax-free supervisor) must be able to emit events
+    without dialing a backend."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _jsonify(obj):
+    """Best-effort JSON coercion for payload values (numpy/jax scalars,
+    tuples, paths); unknown types fall back to ``str``."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [_jsonify(v) for v in obj]
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) in (None, 0):
+        try:
+            return _jsonify(obj.item())
+        except Exception:
+            pass
+    if hasattr(obj, "tolist"):
+        try:
+            return _jsonify(obj.tolist())
+        except Exception:
+            pass
+    return str(obj)
+
+
+class EventLog:
+    """Append-only JSONL event sink.
+
+    :arg path: output file (parent directories are created), or ``None``
+        for a disabled sink whose :meth:`emit` is a cheap no-op.
+    :arg host: override the host id (default: lazy jax process index).
+
+    Thread-safe; every line is flushed on write so concurrently-appending
+    processes (orchestrator + payload) interleave whole lines.
+    """
+
+    def __init__(self, path=None, host=None):
+        self.path = None if path is None else os.path.abspath(str(path))
+        self._host = host
+        self._lock = threading.Lock()
+        self._file = None
+        self._warned = False
+        if self.path is not None:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._file = open(self.path, "a")
+
+    @property
+    def enabled(self):
+        return self._file is not None
+
+    def emit(self, kind, step=None, **data):
+        """Append one event; returns the record dict (``None`` when
+        disabled or on a failed write — telemetry is best-effort by
+        design and must never kill the instrumented run)."""
+        if self._file is None:  # cheap pre-check; re-read under the lock
+            return None
+        rec = {"v": SCHEMA_VERSION, "ts": time.time(),
+               "mono": time.monotonic(),
+               "host": self._host if self._host is not None else _host_id(),
+               "kind": str(kind),
+               "step": None if step is None else int(step),
+               "data": _jsonify(data)}
+        line = json.dumps(rec)
+        with self._lock:
+            f = self._file  # may have been closed/reconfigured since
+            if f is None:
+                return None
+            try:
+                f.write(line + "\n")
+                f.flush()
+            except (OSError, ValueError) as e:  # ENOSPC, closed file, ...
+                if not self._warned:
+                    self._warned = True
+                    print(f"pystella_tpu.obs: event log write failed "
+                          f"({e}); further events may be lost",
+                          file=sys.stderr)
+                return None
+        return rec
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+#: module default: lazily built from ``PYSTELLA_EVENT_LOG`` on first use
+_default = None
+
+
+def get_log():
+    """The process-default :class:`EventLog` (disabled sink unless
+    :func:`configure` was called or ``PYSTELLA_EVENT_LOG`` is set). An
+    unopenable ``PYSTELLA_EVENT_LOG`` path degrades to the disabled sink
+    with a stderr warning — implicit env-driven telemetry must never
+    kill the instrumented run (an explicit :func:`configure` call still
+    raises, so startup misconfiguration surfaces)."""
+    global _default
+    if _default is None:
+        path = os.environ.get("PYSTELLA_EVENT_LOG") or None
+        try:
+            _default = EventLog(path)
+        except OSError as e:
+            print(f"pystella_tpu.obs: cannot open event log {path!r} "
+                  f"({e}); events disabled", file=sys.stderr)
+            _default = EventLog(None)
+    return _default
+
+
+def configure(path=None, host=None):
+    """(Re)point the process-default event log at ``path`` (``None``
+    disables). Returns the new log; the previous one is closed."""
+    global _default
+    old, _default = _default, EventLog(path, host=host)
+    if old is not None:
+        old.close()
+    return _default
+
+
+def emit(kind, step=None, **data):
+    """Emit on the process-default log (no-op when unconfigured)."""
+    return get_log().emit(kind, step=step, **data)
+
+
+def read_events(path, kind=None):
+    """Load events from a JSONL file (newest last). Torn trailing lines
+    from a killed writer are skipped, like ``bench.py``'s line cache.
+    ``kind`` optionally filters."""
+    out = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue  # torn line
+                if kind is None or rec.get("kind") == kind:
+                    out.append(rec)
+    except OSError:
+        return []
+    return out

@@ -1,0 +1,69 @@
+"""Named trace scopes for hot paths.
+
+One context manager, two sinks:
+
+- ``jax.named_scope`` attaches the name to every op traced inside, so
+  compiled-code profiles (Perfetto / TensorBoard traces captured with
+  :class:`pystella_tpu.trace`) show ``fused_rk_stage_pair`` /
+  ``halo_exchange`` / ``pallas_stencil`` regions instead of raw XLA op
+  names;
+- ``jax.profiler.TraceAnnotation`` marks the host-side timeline, so
+  eager driver loops (per-stage protocol, multigrid cycle orchestration)
+  show up as named spans in the same trace.
+
+Both are no-ops costing ~a microsecond when no profiler is attached and
+are platform-agnostic (the CPU test suite runs them constantly).
+
+The scope names survive into the lowered MLIR's debug locations, which
+is how tests verify instrumentation without capturing a real trace:
+:func:`lowered_scopes` / :func:`has_scope` parse them back out of a
+``jax.jit(...).lower(...)`` result.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import re
+
+import jax
+
+__all__ = ["trace_scope", "traced", "lowered_scopes", "has_scope"]
+
+
+@contextlib.contextmanager
+def trace_scope(name):
+    """Name everything inside for both compiled-code traces
+    (``jax.named_scope``) and the host timeline
+    (``jax.profiler.TraceAnnotation``)."""
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def traced(name=None):
+    """Decorator form of :func:`trace_scope` (defaults to the function's
+    ``__name__``)."""
+    def wrap(fn):
+        scope_name = name if name is not None else fn.__name__
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with trace_scope(scope_name):
+                return fn(*args, **kwargs)
+        return inner
+    return wrap
+
+
+def lowered_scopes(lowered):
+    """The set of debug-location name paths in a ``jax.stages.Lowered``
+    — every ``jax.named_scope`` entered during tracing appears as a
+    path component (e.g. ``jit(step)/fused_rk_stage_pair/concatenate``).
+    Used by tests to assert instrumentation presence under CPU lowering,
+    no TPU or live profiler required."""
+    asm = lowered.compiler_ir().operation.get_asm(enable_debug_info=True)
+    return set(re.findall(r'loc\("([^"]*)"', asm))
+
+
+def has_scope(lowered, name):
+    """True when ``name`` appears in any of ``lowered``'s scope paths."""
+    return any(name in path for path in lowered_scopes(lowered))

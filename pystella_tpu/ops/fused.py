@@ -42,6 +42,9 @@ import jax.numpy as jnp
 
 from pystella_tpu import field as _field
 from pystella_tpu import step as _step
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import metrics as _metrics
+from pystella_tpu.obs.scope import trace_scope
 from pystella_tpu.ops.derivs import _grad_coefs, _lap_coefs
 from pystella_tpu.ops.pallas_stencil import (
     ResidentStencil, StreamingStencil,
@@ -203,6 +206,19 @@ class FusedScalarStepper(_step.Stepper):
                         or self._py > 1 or bx is not None
                         or by is not None):
                     raise
+        if self._assemble == "update":
+            # an explicit low-peak-HBM request lands on the resident tier,
+            # where there are no y-slab outputs to assemble — say so
+            # instead of silently dropping the option
+            import warnings
+            warnings.warn(
+                "assemble='update' requested, but this lattice selected "
+                "the whole-lattice-resident kernel tier, where y-slab "
+                "assembly does not apply; the option is ignored",
+                stacklevel=4)
+            _events.emit("assemble_fallback", tier="resident",
+                         requested="update",
+                         local_shape=self.local_shape)
         return ResidentStencil(self.local_shape, win_defs, self.h, body,
                                out_defs, interpret=self._interpret,
                                **common)
@@ -475,10 +491,11 @@ class FusedScalarStepper(_step.Stepper):
 
     def stage(self, s, carry, t, dt, rhs_args):
         state, k = carry
-        outs = self._scalar_call(
-            {"f": state["f"]},
-            self._stage_scalars(s, dt, rhs_args),
-            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"]})
+        with trace_scope("fused_rk_stage"):
+            outs = self._scalar_call(
+                {"f": state["f"]},
+                self._stage_scalars(s, dt, rhs_args),
+                {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"]})
         return ({"f": outs["f"], "dfdt": outs["dfdt"]},
                 {"f": outs["kf"], "dfdt": outs["kdfdt"]})
 
@@ -507,10 +524,11 @@ class FusedScalarStepper(_step.Stepper):
         """Like :meth:`stage`, additionally returning the raw energy sums
         of the stage's entry state (see :meth:`_esums`)."""
         state, k = carry
-        outs = self._es_call(
-            {"f": state["f"]},
-            self._stage_scalars(s, dt, rhs_args),
-            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"]})
+        with trace_scope("fused_rk_stage_energy"):
+            outs = self._es_call(
+                {"f": state["f"]},
+                self._stage_scalars(s, dt, rhs_args),
+                {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"]})
         return (({"f": outs["f"], "dfdt": outs["dfdt"]},
                  {"f": outs["kf"], "dfdt": outs["kdfdt"]}), outs["esums"])
 
@@ -551,10 +569,11 @@ class FusedScalarStepper(_step.Stepper):
         boundary is a no-op) — see :meth:`multi_step`."""
         self._check_pair(s, s + 1 if s2 is None else s2)
         state, k = carry
-        outs = self._pair_call(
-            {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"]},
-            self._pair_scalars(s, dt, rhs_args, rhs_args2, s2),
-            {"kdfdt": k["dfdt"]})
+        with trace_scope("fused_rk_stage_pair"):
+            outs = self._pair_call(
+                {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"]},
+                self._pair_scalars(s, dt, rhs_args, rhs_args2, s2),
+                {"kdfdt": k["dfdt"]})
         return ({"f": outs["f"], "dfdt": outs["dfdt"]},
                 {"f": outs["kf"], "dfdt": outs["kdfdt"]})
 
@@ -656,11 +675,13 @@ class FusedScalarStepper(_step.Stepper):
             fn = jax.jit(functools.partial(
                 self._multi_step_impl, nsteps=nsteps), donate_argnums=0)
             self._jit_multi[key] = fn
+        _metrics.counter("steps").inc(nsteps)
         return fn(state, t=t, dt=dt, rhs_args=rhs_args or {},
                   rhs_seq=rhs_seq or {})
 
     def step(self, state, t=0.0, dt=None, rhs_args=None):
         dt = dt if dt is not None else self.dt
+        _metrics.counter("steps").inc()
         return self._jit_step(state, t, dt, rhs_args or {})
 
     # -- deferred-drag coupled pair kernels --------------------------------
@@ -946,10 +967,12 @@ class FusedScalarStepper(_step.Stepper):
                 scalars["hubfix"] = hubfix
                 scalars["B2p"] = B2p
                 wins, extras = self._def_in_deferred(carry)
-                outs = call_deferred(wins, scalars, extras)
+                with trace_scope("fused_coupled_pair"):
+                    outs = call_deferred(wins, scalars, extras)
             else:
                 wins, extras = self._def_in_normal(carry)
-                outs = call_normal(wins, scalars, extras)
+                with trace_scope("fused_coupled_pair"):
+                    outs = call_normal(wins, scalars, extras)
             carry = self._def_out(outs)
             deferred = True
             # exact background integration from the true esums
@@ -1014,6 +1037,7 @@ class FusedScalarStepper(_step.Stepper):
                 impl, nsteps=nsteps, grid_size=grid_size,
                 mpl=mpl), donate_argnums=0)
             self._jit_coupled[key] = fn
+        _metrics.counter("steps").inc(nsteps)
         state, a, adot = fn(state, t=t, dt=dt,
                             a=jnp.asarray(float(expansion.a)),
                             adot=jnp.asarray(float(expansion.adot)))
@@ -1190,12 +1214,13 @@ class FusedPreheatStepper(FusedScalarStepper):
         :meth:`FusedScalarStepper.stage_pair`)."""
         self._check_pair(s, s + 1 if s2 is None else s2)
         state, k = carry
-        outs = self._pair_call(
-            {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"],
-             "hij": state["hij"], "dhijdt": state["dhijdt"],
-             "khij": k["hij"]},
-            self._pair_scalars(s, dt, rhs_args, rhs_args2, s2),
-            {"kdfdt": k["dfdt"], "kdhijdt": k["dhijdt"]})
+        with trace_scope("fused_rk_stage_pair"):
+            outs = self._pair_call(
+                {"f": state["f"], "dfdt": state["dfdt"], "kf": k["f"],
+                 "hij": state["hij"], "dhijdt": state["dhijdt"],
+                 "khij": k["hij"]},
+                self._pair_scalars(s, dt, rhs_args, rhs_args2, s2),
+                {"kdfdt": k["dfdt"], "kdhijdt": k["dhijdt"]})
         return ({"f": outs["f"], "dfdt": outs["dfdt"],
                  "hij": outs["hij"], "dhijdt": outs["dhijdt"]},
                 {"f": outs["kf"], "dfdt": outs["kdfdt"],
@@ -1203,12 +1228,13 @@ class FusedPreheatStepper(FusedScalarStepper):
 
     def stage(self, s, carry, t, dt, rhs_args):
         state, k = carry
-        outs = self._both_call(
-            {"f": state["f"], "hij": state["hij"]},
-            self._stage_scalars(s, dt, rhs_args),
-            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"],
-             "dhijdt": state["dhijdt"], "khij": k["hij"],
-             "kdhijdt": k["dhijdt"]})
+        with trace_scope("fused_rk_stage"):
+            outs = self._both_call(
+                {"f": state["f"], "hij": state["hij"]},
+                self._stage_scalars(s, dt, rhs_args),
+                {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"],
+                 "dhijdt": state["dhijdt"], "khij": k["hij"],
+                 "kdhijdt": k["dhijdt"]})
         new_state = {"f": outs["f"], "dfdt": outs["dfdt"],
                      "hij": outs["hij"], "dhijdt": outs["dhijdt"]}
         new_k = {"f": outs["kf"], "dfdt": outs["kdfdt"],
@@ -1331,12 +1357,13 @@ class FusedPreheatStepper(FusedScalarStepper):
 
     def _stage_energy(self, s, carry, t, dt, rhs_args):
         state, k = carry
-        outs = self._es_call(
-            {"f": state["f"], "hij": state["hij"]},
-            self._stage_scalars(s, dt, rhs_args),
-            {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"],
-             "dhijdt": state["dhijdt"], "khij": k["hij"],
-             "kdhijdt": k["dhijdt"]})
+        with trace_scope("fused_rk_stage_energy"):
+            outs = self._es_call(
+                {"f": state["f"], "hij": state["hij"]},
+                self._stage_scalars(s, dt, rhs_args),
+                {"dfdt": state["dfdt"], "kf": k["f"], "kdfdt": k["dfdt"],
+                 "dhijdt": state["dhijdt"], "khij": k["hij"],
+                 "kdhijdt": k["dhijdt"]})
         new_state = {"f": outs["f"], "dfdt": outs["dfdt"],
                      "hij": outs["hij"], "dhijdt": outs["dhijdt"]}
         new_k = {"f": outs["kf"], "dfdt": outs["kdfdt"],

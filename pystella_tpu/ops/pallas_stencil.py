@@ -42,9 +42,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from pystella_tpu import _compat
+from pystella_tpu.obs.scope import trace_scope
+
 __all__ = ["StreamingStencil", "ResidentStencil", "Taps", "HY", "LANE",
            "choose_blocks", "sharded_halo", "lap_from_taps",
-           "grad_from_taps", "VMEM_LIMIT_BYTES"]
+           "grad_from_taps", "vmem_limit_bytes", "VMEM_LIMIT_BYTES"]
 
 #: aligned y-halo width (one sublane tile); must be >= the stencil radius
 HY = 8
@@ -60,18 +63,29 @@ LANE = 128
 
 _RING = 4  # x-block ring slots: 3 live + 1 in flight
 
-#: Scoped-VMEM limit requested from Mosaic for every compiled stencil
-#: kernel. XLA's *default* scoped limit is 16 MB (measured on v5e: the
-#: 25 MB wave-64^3 resident kernel compiled fine in interpret mode but
-#: Mosaic rejected it with "Scoped allocation with size 25.40M and limit
-#: 16.00M exceeded scoped vmem limit"), far below the 128 MB of physical
-#: VMEM — so the Python-level budgets (``choose_blocks``,
-#: ``ResidentStencil(budget=...)``) were silently stricter than they
-#: claimed. Requesting the limit per kernel via
-#: ``CompilerParams(vmem_limit_bytes=...)`` makes the physical capacity
-#: available; 100 MB leaves headroom for Mosaic's own scratch.
-VMEM_LIMIT_BYTES = int(
-    float(os.environ.get("PYSTELLA_VMEM_LIMIT_MB", "100")) * 2**20)
+def vmem_limit_bytes():
+    """Scoped-VMEM limit requested from Mosaic for every compiled stencil
+    kernel. XLA's *default* scoped limit is 16 MB (measured on v5e: the
+    25 MB wave-64^3 resident kernel compiled fine in interpret mode but
+    Mosaic rejected it with "Scoped allocation with size 25.40M and limit
+    16.00M exceeded scoped vmem limit"), far below the 128 MB of physical
+    VMEM — so the Python-level budgets (``choose_blocks``,
+    ``ResidentStencil(budget=...)``) were silently stricter than they
+    claimed. Requesting the limit per kernel via
+    ``CompilerParams(vmem_limit_bytes=...)`` makes the physical capacity
+    available; 100 MB leaves headroom for Mosaic's own scratch.
+
+    ``PYSTELLA_VMEM_LIMIT_MB`` is read here, at each kernel build —
+    matching how :func:`choose_blocks` reads ``PYSTELLA_BLOCK_BUDGET_MB``
+    — so sweep harnesses can vary it between builds in one process (an
+    import-time read froze the first value for the whole run)."""
+    return int(float(os.environ.get("PYSTELLA_VMEM_LIMIT_MB", "100"))
+               * 2**20)
+
+
+#: import-time snapshot of :func:`vmem_limit_bytes`, kept for callers
+#: that report the configured limit; kernel builds re-read the env.
+VMEM_LIMIT_BYTES = vmem_limit_bytes()
 
 
 def _compiler_params(interpret):
@@ -79,7 +93,7 @@ def _compiler_params(interpret):
     mode — TPU-specific params are meaningless there)."""
     if interpret:
         return None
-    return pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT_BYTES)
+    return _compat.tpu_compiler_params(vmem_limit_bytes=vmem_limit_bytes())
 
 
 def sharded_halo(h, px, py):
@@ -395,7 +409,8 @@ class ResidentStencil:
         scalar_args = [jnp.asarray(scalars[n], self.dtype).reshape(1)
                        for n in self.scalar_names]
         extra_args = [extras[n] for n in self.extra_defs]
-        res = self._call(*win_args, *scalar_args, *extra_args)
+        with trace_scope("pallas_resident_stencil"):
+            res = self._call(*win_args, *scalar_args, *extra_args)
         out = {}
         names = list(self.out_defs) + list(self.sum_defs)
         for n, arr in zip(names, res):
@@ -794,7 +809,8 @@ class StreamingStencil:
                     self.dtypes.get(n, self.dtype))
             sums = dict.fromkeys(self.sum_defs, 0)
             for j, call in enumerate(self._calls):
-                res = call(*win_args, *scalar_args, *extra_args)
+                with trace_scope("pallas_stencil"):
+                    res = call(*win_args, *scalar_args, *extra_args)
                 for k, n in enumerate(out_names):
                     yax = len(self.out_defs[n]) + 1
                     out[n] = jax.lax.dynamic_update_slice_in_dim(
@@ -804,8 +820,9 @@ class StreamingStencil:
             out.update(sums)
             return out
 
-        slabs = [call(*win_args, *scalar_args, *extra_args)
-                 for call in self._calls]
+        with trace_scope("pallas_stencil"):
+            slabs = [call(*win_args, *scalar_args, *extra_args)
+                     for call in self._calls]
         for k, n in enumerate(out_names):
             if nby == 1:
                 out[n] = slabs[0][k]

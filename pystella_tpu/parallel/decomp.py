@@ -33,7 +33,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pystella_tpu import _compat
 
 __all__ = ["DomainDecomposition", "make_mesh"]
 
@@ -56,13 +58,12 @@ def make_mesh(proc_shape=None, axis_names=("x", "y", "z"), devices=None):
     # Explicit axis types: required by the declarative pencil-FFT reshards
     # (jax.sharding.reshard refuses Auto axes). On a single-device mesh
     # nothing is ever resharded and explicit-sharding type tracking only
-    # gets in the way (e.g. of pallas_call), so use Auto there.
-    if len(devices) == 1:
-        axis_types = (AxisType.Auto,) * len(proc_shape)
-    else:
-        axis_types = (AxisType.Explicit,) * len(proc_shape)
+    # gets in the way (e.g. of pallas_call), so use Auto there. Runtimes
+    # predating axis types build a plain mesh (resharding then goes
+    # through with_sharding_constraint — see pystella_tpu._compat).
     return Mesh(mesh_devices, axis_names[:len(proc_shape)],
-                axis_types=axis_types)
+                **_compat.mesh_axis_types(len(proc_shape),
+                                          explicit=len(devices) > 1))
 
 
 class DomainDecomposition:
@@ -233,6 +234,10 @@ class DomainDecomposition:
             exchange = (exchange,) * len(self.axis_names)
         if lattice_axes is None:
             lattice_axes = tuple(range(x.ndim - len(self.axis_names), x.ndim))
+        with jax.named_scope("halo_exchange"):
+            return self._pad_with_halos(x, halo, lattice_axes, exchange)
+
+    def _pad_with_halos(self, x, halo, lattice_axes, exchange):
         for d, ax in enumerate(lattice_axes):
             h = halo[d]
             if h == 0:
@@ -283,6 +288,11 @@ class DomainDecomposition:
         if np.isscalar(halo):
             halo = (halo,) * len(self.axis_names)
         halo = tuple(int(h) for h in halo)
+        # exact host-level count (pad_with_halos itself runs at trace
+        # time inside jitted consumers, where a counter would tally
+        # traces, not executions)
+        from pystella_tpu.obs import metrics as _metrics
+        _metrics.counter("halo_exchanges").inc()
         fn = self._share_halos_cache.get((halo, outer_axes))
         if fn is None:
             spec = self.spec(outer_axes)
@@ -290,18 +300,19 @@ class DomainDecomposition:
             def body(x):
                 return self.pad_with_halos(x, halo)
 
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(_compat.shard_map(
                 body, mesh=self.mesh, in_specs=spec, out_specs=spec))
             self._share_halos_cache[(halo, outer_axes)] = fn
         return fn(array)
 
     def shard_map(self, fn, in_specs, out_specs, **kwargs):
-        """Thin wrapper over ``jax.shard_map`` bound to this mesh.
+        """Thin wrapper over ``jax.shard_map`` bound to this mesh (via
+        the version shim in :mod:`pystella_tpu._compat`).
         ``check_vma=False`` is needed for bodies containing ``pallas_call``
         (whose outputs carry no varying-mesh-axes annotation)."""
-        return jax.shard_map(fn, mesh=self.mesh,
-                             in_specs=in_specs, out_specs=out_specs,
-                             **kwargs)
+        return _compat.shard_map(fn, mesh=self.mesh,
+                                 in_specs=in_specs, out_specs=out_specs,
+                                 **kwargs)
 
     # -- bookkeeping matching reference get_rank_shape_start ----------------
 

@@ -20,7 +20,8 @@ from __future__ import annotations
 import jax
 
 __all__ = ["init_multihost", "is_initialized", "global_devices",
-           "host_local_to_global", "global_to_host_local", "sync_hosts"]
+           "host_local_to_global", "global_to_host_local", "sync_hosts",
+           "all_gather_hosts"]
 
 _initialized = False
 
@@ -73,6 +74,22 @@ def global_to_host_local(decomp, global_array, outer_axes=0):
     from jax.experimental import multihost_utils
     return multihost_utils.global_array_to_host_local_array(
         global_array, decomp.mesh, decomp.spec(outer_axes))
+
+
+def all_gather_hosts(values):
+    """Gather a small per-host numeric vector from every host; returns a
+    ``(num_hosts, len(values))`` numpy array (host order = process
+    index). The telemetry primitive behind
+    :meth:`pystella_tpu.obs.metrics.MetricsRegistry.aggregate` — each
+    host contributes its local metric snapshot and host 0 reports the
+    fleet-wide reduction. Single-process runs return ``values[None]``
+    without touching the device."""
+    import numpy as np
+    values = np.atleast_1d(np.asarray(values, np.float64))
+    if jax.process_count() == 1:
+        return values[None]
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(values))
 
 
 def sync_hosts(name="sync"):

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from pystella_tpu import field as _field
+from pystella_tpu.obs.scope import trace_scope
 
 __all__ = [
     "Stepper", "RungeKuttaStepper", "LowStorageRKStepper", "compile_rhs_dict",
@@ -116,7 +117,8 @@ class Stepper:
         def _step_impl(state, t, dt, rhs_args):
             carry = self.init_carry(state)
             for s in range(self.num_stages):
-                carry = self.stage(s, carry, t, dt, rhs_args)
+                with trace_scope(f"rk_stage{s}"):
+                    carry = self.stage(s, carry, t, dt, rhs_args)
             return self.extract(carry)
 
         # one fused XLA computation per (state structure, rhs_args

@@ -20,6 +20,8 @@ import os
 
 import numpy as np
 
+from pystella_tpu.obs import events as _events
+
 __all__ = ["Checkpointer"]
 
 
@@ -63,6 +65,9 @@ class Checkpointer:
             args["meta"] = ocp.args.JsonSave(_jsonify(metadata))
         saved = self._mngr.save(int(step), args=ocp.args.Composite(**args),
                                 force=force)
+        if saved:
+            _events.emit("checkpoint_save", step=step,
+                         directory=self.directory)
         return bool(saved)
 
     def maybe_save(self, step, state, metadata=None):
@@ -124,6 +129,8 @@ class Checkpointer:
         if sharding_fn is not None:
             import jax
             state = jax.tree_util.tree_map(sharding_fn, state)
+        _events.emit("checkpoint_restore", step=step,
+                     directory=self.directory)
         return int(step), state, meta
 
     def close(self):

@@ -16,6 +16,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import metrics as _metrics
+
 __all__ = ["HealthMonitor", "SimulationDiverged"]
 
 
@@ -76,6 +79,11 @@ class HealthMonitor:
 
         bad = [name(path) for path, v in leaves
                if not bool(np.asarray(v))]
+        _metrics.counter("health_checks").inc()
         if bad:
+            # the forensic record a checkpointed run resumes from: which
+            # fields went non-finite, and exactly when
+            _events.emit("diverged", step=step, fields=bad,
+                         max_abs=self.max_abs)
             raise SimulationDiverged(step, bad)
         return True

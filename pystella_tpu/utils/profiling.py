@@ -13,6 +13,9 @@ import time
 
 import jax
 
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import metrics as _metrics
+
 __all__ = ["timer", "trace", "StepTimer"]
 
 
@@ -70,7 +73,11 @@ class StepTimer:
 
     Call :meth:`tick` once per step; it returns a ``(ms_per_step,
     steps_per_s)`` tuple every ``report_every`` seconds and ``None``
-    otherwise.
+    otherwise. Each report also lands in the telemetry subsystem: a
+    ``kind="step_timer"`` run event and the ``ms_per_step`` /
+    ``steps_per_s`` gauges plus a ``step.ema_ms`` EMA in the default
+    metrics registry (so :func:`pystella_tpu.obs.metrics.registry`
+    aggregation reports fleet-wide step rates).
     """
 
     def __init__(self, report_every=30.0):
@@ -81,6 +88,13 @@ class StepTimer:
         self.last_report = None
         self.steps_at_report = 0
         self.steps = 0
+        # register the metrics NOW: SPMD hosts construct StepTimer in
+        # lockstep but cross report_every at slightly different wall
+        # times, and aggregate() requires every host to export the same
+        # metric set (values stay NaN until the first report)
+        _metrics.gauge("ms_per_step")
+        _metrics.gauge("steps_per_s")
+        _metrics.timer("step")
 
     def tick(self):
         self.steps += 1
@@ -95,4 +109,9 @@ class StepTimer:
         ms = (now - self.last_report) * 1e3 / window_steps
         self.last_report = now
         self.steps_at_report = self.steps
+        _metrics.gauge("ms_per_step").set(ms)
+        _metrics.gauge("steps_per_s").set(1e3 / ms)
+        _metrics.timer("step").observe(ms / 1e3)
+        _events.emit("step_timer", step=self.steps, ms_per_step=ms,
+                     steps_per_s=1e3 / ms)
         return ms, 1e3 / ms

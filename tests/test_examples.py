@@ -72,6 +72,7 @@ def test_scalar_preheating_gws(tmp_path):
         assert "spectra" in f and "gw" in f["spectra"]
 
 
+@pytest.mark.slow
 def test_scalar_preheating_gws_coupled_chunks(tmp_path):
     """The full scalar+GW system driven through the CLI's energy-coupled
     chunked hot loop (deferred-drag pair kernels at 16^3): the headline
@@ -102,6 +103,7 @@ def test_scalar_preheating_fused_matches_golden(tmp_path):
         f"constraint {constraint} vs golden {GOLDEN_CONSTRAINT}"
 
 
+@pytest.mark.slow
 def test_scalar_preheating_chunked_frozen_rho_bound(tmp_path):
     """--chunk-steps drives the hot loop through multi_step (stage pairs
     across step boundaries) with a frozen-rho per-chunk expansion

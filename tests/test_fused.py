@@ -92,6 +92,7 @@ def test_pair_stages_match_single_stages(decomp):
         assert err / scale < 1e-14, f"{name}: pair/single diverge ({err})"
 
 
+@pytest.mark.slow
 def test_multi_step_matches_sequential_steps(decomp):
     """multi_step pairs stages across step boundaries (A[0] == 0 makes
     the skipped k-carry reset a no-op) and must be bit-exact against
@@ -172,6 +173,7 @@ def test_multi_step_rhs_seq_matches_per_stage_loop(decomp):
                          rhs_seq={"a": a_seq[:-1]})
 
 
+@pytest.mark.slow
 def test_coupled_multi_step_matches_driver_loop(decomp):
     """coupled_multi_step integrates the Friedmann ODE on device with
     per-stage energy feedback from in-kernel reductions; it must
@@ -252,6 +254,7 @@ def test_coupled_multi_step_matches_driver_loop(decomp):
         < 1e-12
 
 
+@pytest.mark.slow
 def test_coupled_multi_step_gw(decomp):
     """The scalar+GW coupled chunk matches the per-stage driver loop
     (expansion couples to the scalar-sector energy only)."""
@@ -364,6 +367,7 @@ def test_coupled_multi_step_sharded_x_matches_single():
     assert abs(adot2 - adot1) / abs(adot1) < 1e-13
 
 
+@pytest.mark.slow
 def test_coupled_pair_accuracy_vs_driver(decomp):
     """The deferred-drag pair-coupled path is EXACT: against the
     per-stage coupled path (itself driver-loop-parity to summation
@@ -408,6 +412,7 @@ def test_coupled_pair_accuracy_vs_driver(decomp):
         assert abs(adot_got - adot_ref) / abs(adot_ref) < 1e-12
 
 
+@pytest.mark.slow
 def test_bf16_carry_accuracy(decomp):
     """``carry_dtype=bfloat16`` stores the 2N RK carries at half width
     (the 512^3-GW-on-one-chip memory flag, VERDICT r4 #6) while all
@@ -479,6 +484,7 @@ def test_stage_pair_guards(decomp):
         paired.stage_pair(4, paired.init_carry(state), 0.0, 0.01, {}, s2=1)
 
 
+@pytest.mark.slow
 def test_preheat_pair_stages_match_single_stages(decomp):
     """Same bit-level pair/single equivalence for the scalar+GW system
     (lap(h1) and S_ij(grad f1) compose through the axpy taps)."""
@@ -697,6 +703,7 @@ def test_fused_scalar_sharded_2d_matches_single(proc):
                            rtol=1e-13, atol=1e-13), name
 
 
+@pytest.mark.slow
 def test_fused_preheat_sharded_2d_matches_single():
     """Scalar+GW fused stages (pair kernels in step()) on a (2, 2, 1)
     mesh match the single-device path, and the energy-coupled chunk
@@ -800,6 +807,7 @@ if __name__ == "__main__":
                   nbytes=(8 * 2 + 8) * 2 * nsites * isize, nsites=nsites)
 
 
+@pytest.mark.slow
 def test_fused_scalar_resident_matches_streaming(decomp):
     """resident=True forces the whole-lattice-resident stage kernels
     (the compiled Z < 128 tier); same arithmetic, same results as the

@@ -1,0 +1,345 @@
+"""Telemetry subsystem tests: event-log schema round-trip, counter/gauge
+aggregation on the virtual multi-device CPU mesh, trace-scope no-op
+safety under ``JAX_PLATFORMS=cpu``, named-scope presence in a fused-step
+lowering, and memory-analysis capture for one fused kernel."""
+
+import importlib
+import os
+
+import numpy as np
+import pytest
+
+import common  # noqa: F401  (side effect: forces the CPU platform)
+import jax
+import jax.numpy as jnp
+
+import pystella_tpu as ps
+from pystella_tpu import obs
+from pystella_tpu.obs import events, metrics
+
+
+@pytest.fixture
+def event_log(tmp_path):
+    """Point the process-default event log at a temp file; restore the
+    disabled sink afterwards so tests don't leak configuration."""
+    path = tmp_path / "events.jsonl"
+    events.configure(str(path))
+    yield str(path)
+    events.configure(None)
+
+
+def _small_fused(decomp, n=8, dtype=np.float32, **kwargs):
+    grid_shape = (n, n, n)
+    lattice = ps.Lattice(grid_shape, (5.0,) * 3, dtype=dtype)
+    dt = dtype(0.1 * min(lattice.dx))
+    sector = ps.ScalarSector(1, potential=lambda f: 0.5 * f[0]**2)
+    stepper = ps.FusedScalarStepper(sector, decomp, grid_shape,
+                                    lattice.dx, 2, dtype=dtype, dt=dt,
+                                    **kwargs)
+    rng = np.random.default_rng(17)
+    state = {k: decomp.shard(
+        0.1 * rng.standard_normal((1,) + grid_shape).astype(dtype))
+        for k in ("f", "dfdt")}
+    return stepper, state, dt
+
+
+# -- events ----------------------------------------------------------------
+
+def test_event_schema_roundtrip(event_log):
+    events.emit("unit_test", step=3, value=1.5, name="x",
+                arr=np.float32(2.0))
+    events.emit("other_kind")
+    recs = events.read_events(event_log)
+    assert len(recs) == 2
+    ev = recs[0]
+    assert ev["v"] == events.SCHEMA_VERSION
+    assert isinstance(ev["ts"], float) and isinstance(ev["mono"], float)
+    assert ev["host"] == 0  # single-process run
+    assert ev["kind"] == "unit_test" and ev["step"] == 3
+    assert ev["data"] == {"value": 1.5, "name": "x", "arr": 2.0}
+    assert recs[1]["step"] is None
+    # monotonic timestamps order events within one process
+    assert recs[1]["mono"] >= ev["mono"]
+    # kind filter
+    assert [r["kind"] for r in events.read_events(
+        event_log, kind="other_kind")] == ["other_kind"]
+
+
+def test_event_log_tolerates_torn_lines(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    with events.EventLog(str(path)) as log:
+        log.emit("ok", value=1)
+    with open(path, "a") as f:
+        f.write('{"v": 1, "kind": "torn", "da')  # killed mid-write
+    recs = events.read_events(str(path))
+    assert [r["kind"] for r in recs] == ["ok"]
+
+
+def test_disabled_sink_is_noop(tmp_path):
+    log = events.EventLog(None)
+    assert not log.enabled
+    assert log.emit("anything", x=1) is None
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_counter_gauge_timer_exports():
+    reg = metrics.MetricsRegistry()
+    reg.counter("steps").inc(5)
+    reg.counter("steps").inc()  # get-or-create returns the same object
+    reg.gauge("peak", reduce="max").set(7.0)
+    t = reg.timer("halo", ema_alpha=0.5)
+    t.observe(0.010)
+    t.observe(0.020)
+    snap = reg.snapshot()
+    assert snap["steps"] == 6.0
+    assert snap["peak"] == 7.0
+    assert snap["halo.count"] == 2.0
+    assert snap["halo.total_s"] == pytest.approx(0.030)
+    assert snap["halo.ema_ms"] == pytest.approx(15.0)  # 0.5*20 + 0.5*10
+    assert list(snap) == sorted(snap)  # stable cross-host ordering
+    with pytest.raises(TypeError):
+        reg.gauge("steps")  # kind mismatch
+
+
+def test_reduce_snapshots_multihost_semantics():
+    """The cross-host reduction core, fed per-host snapshots directly —
+    testable without a multi-process cluster."""
+    reg = metrics.MetricsRegistry()
+    reg.counter("steps")
+    reg.gauge("ms_per_step", reduce="mean")
+    reg.gauge("peak_hbm", reduce="max")
+    hosts = [{"steps": 100.0, "ms_per_step": 10.0, "peak_hbm": 1.0},
+             {"steps": 100.0, "ms_per_step": 20.0, "peak_hbm": 5.0},
+             {"steps": 101.0, "ms_per_step": 30.0, "peak_hbm": 2.0}]
+    out = reg.reduce_snapshots(hosts)
+    assert out["steps"] == 301.0          # counters sum
+    assert out["ms_per_step"] == 20.0     # gauges reduce as declared
+    assert out["peak_hbm"] == 5.0
+
+
+def test_aggregate_on_virtual_mesh(decomp):
+    """aggregate() runs the real gather path (all_gather_hosts) with the
+    8-device CPU mesh live; single-process it must equal the local
+    snapshot."""
+    from pystella_tpu.parallel.multihost import all_gather_hosts
+    stacked = all_gather_hosts([1.0, 2.0, 3.0])
+    assert stacked.shape == (1, 3)
+    np.testing.assert_array_equal(stacked[0], [1.0, 2.0, 3.0])
+
+    reg = metrics.MetricsRegistry()
+    reg.counter("steps").inc(42)
+    reg.gauge("rate", reduce="mean").set(3.5)
+    assert reg.aggregate() == reg.snapshot()
+    assert reg.aggregate()["steps"] == 42.0
+
+
+def test_default_registry_accessors():
+    c = metrics.counter("obs_test_counter")
+    c.inc(2)
+    assert metrics.registry().snapshot()["obs_test_counter"] == 2.0
+
+
+# -- trace scopes ----------------------------------------------------------
+
+def test_trace_scope_noop_safety():
+    """Scopes must be free of side effects on CPU with no profiler
+    attached — eager, jitted, and as a decorator."""
+    with obs.trace_scope("eager_region"):
+        x = jnp.sum(jnp.ones(8))
+    assert float(x) == 8.0
+
+    @jax.jit
+    def f(x):
+        with obs.trace_scope("jit_region"):
+            return x * 2
+
+    assert float(f(jnp.float32(3.0))) == 6.0
+
+    @obs.traced("decorated_region")
+    def g(x):
+        return x + 1
+
+    assert g(1) == 2
+
+
+def test_fused_step_lowering_has_named_scopes(make_decomp):
+    """The acceptance check: a fused step's lowering carries named
+    scopes for the RK stage, the halo exchange, and the stencil kernel
+    regions (the CPU-lowering stand-in for inspecting a Perfetto
+    trace)."""
+    decomp = make_decomp((2, 2, 1))
+    stepper, state, dt = _small_fused(decomp, n=16)
+    lowered = stepper._jit_step.lower(state, 0.0, dt, {})
+    assert obs.has_scope(lowered, "rk_stage")       # RK stage region
+    assert obs.has_scope(lowered, "halo_exchange")  # ppermute halos
+    assert obs.has_scope(lowered, "pallas_stencil")  # stencil kernel
+
+
+def test_generic_stepper_lowering_has_stage_scopes(make_decomp):
+    decomp = make_decomp((1, 1, 1))
+    fd = ps.FiniteDifferencer(decomp, 1, (1.0, 1.0, 1.0))
+
+    def rhs(state, t):
+        return {"f": state["dfdt"], "dfdt": fd.lap(state["f"])}
+
+    stepper = ps.LowStorageRK54(rhs, dt=0.1)
+    rng = np.random.default_rng(3)
+    state = {"f": decomp.shard(rng.standard_normal((8, 8, 8))),
+             "dfdt": decomp.zeros((8, 8, 8), np.float64)}
+    lowered = stepper._jit_step.lower(state, 0.0, 0.1, {})
+    assert obs.has_scope(lowered, "rk_stage0")
+    assert obs.has_scope(lowered, "rk_stage4")
+
+
+# -- memory / compile instrumentation --------------------------------------
+
+def test_compile_report_for_fused_kernel(event_log, make_decomp):
+    """Memory-analysis capture for one fused kernel: compile seconds and
+    the XLA byte counts land in the record and the event log."""
+    decomp = make_decomp((1, 1, 1))
+    stepper, state, dt = _small_fused(decomp, n=8)
+    compiled, rec = obs.compile_with_report(
+        stepper._jit_step, state, 0.0, dt, {}, label="fused-8^3")
+    assert rec.label == "fused-8^3"
+    assert rec.compile_seconds > 0
+    # CPU's memory analysis reports real argument/output byte counts
+    state_bytes = 2 * 8**3 * 4
+    assert rec.argument_bytes >= state_bytes
+    assert rec.output_bytes >= state_bytes
+    assert rec.peak_bytes >= state_bytes
+    # the compiled executable is directly callable (no second compile)
+    out = compiled(state, 0.0, dt, {})
+    assert out["f"].shape == (1, 8, 8, 8)
+
+    evs = events.read_events(event_log, kind="compile")
+    assert len(evs) == 1
+    assert evs[0]["data"]["label"] == "fused-8^3"
+    assert evs[0]["data"]["compile_seconds"] == rec.compile_seconds
+    assert evs[0]["data"]["peak_bytes"] == rec.peak_bytes
+
+
+def test_device_memory_report_degrades_on_cpu(event_log):
+    """CPU devices keep no allocator stats; the report must return None
+    without raising or emitting."""
+    assert obs.device_memory_report(label="cpu") is None
+    assert events.read_events(event_log, kind="device_memory") == []
+
+
+# -- instrumentation wired through the subsystems --------------------------
+
+def test_health_monitor_emits_diverged_event(event_log):
+    mon = ps.HealthMonitor(every=1)
+    state = {"f": jnp.ones((4, 4, 4)),
+             "dfdt": jnp.full((4, 4, 4), np.nan)}
+    with pytest.raises(ps.SimulationDiverged):
+        mon(7, state)
+    evs = events.read_events(event_log, kind="diverged")
+    assert len(evs) == 1
+    assert evs[0]["step"] == 7
+    assert evs[0]["data"]["fields"] == ["dfdt"]
+
+
+def test_step_timer_feeds_metrics_and_events(event_log):
+    st = ps.StepTimer(report_every=0.0)
+    assert st.tick() is None  # first tick arms the clock
+    report = st.tick()
+    assert report is not None
+    ms, rate = report
+    evs = events.read_events(event_log, kind="step_timer")
+    assert len(evs) == 1
+    assert evs[0]["data"]["ms_per_step"] == ms
+    assert metrics.gauge("ms_per_step").value == ms
+
+
+def test_fused_step_counter(make_decomp):
+    decomp = make_decomp((1, 1, 1))
+    stepper, state, dt = _small_fused(decomp, n=8)
+    before = metrics.counter("steps").value
+    state = stepper.step(state, 0.0, dt, {"a": 1.0, "hubble": 0.0})
+    jax.block_until_ready(state)
+    assert metrics.counter("steps").value == before + 1
+
+
+def test_assemble_update_on_resident_tier_warns(event_log, make_decomp):
+    """Satellite: an explicit assemble='update' landing on the resident
+    tier (where slab assembly is moot) warns and logs an event instead
+    of silently ignoring the request."""
+    decomp = make_decomp((1, 1, 1))
+    with pytest.warns(UserWarning, match="resident"):
+        _small_fused(decomp, n=8, resident=True, assemble="update")
+    evs = events.read_events(event_log, kind="assemble_fallback")
+    assert evs and evs[0]["data"]["requested"] == "update"
+
+
+def test_multigrid_unknown_kwargs_raise(make_decomp):
+    """Satellite: a misspelled FullApproximationScheme kwarg (e.g.
+    ``defer_error=``) must raise, not be silently swallowed."""
+    from pystella_tpu.multigrid import (
+        FullApproximationScheme, NewtonIterator)
+    decomp = make_decomp((1, 1, 1))
+    f = ps.Field("f")
+    solver = NewtonIterator(
+        decomp, {f: (ps.Field("lap_f") - f, ps.Field("rho"))},
+        halo_shape=1)
+    with pytest.raises(TypeError, match="defer_error"):
+        FullApproximationScheme(solver=solver, halo_shape=1,
+                                defer_error=True)
+    # the documented spelling still works
+    FullApproximationScheme(solver=solver, halo_shape=1,
+                            defer_errors=False)
+
+
+def test_vmem_limit_read_per_build(monkeypatch):
+    """Satellite: PYSTELLA_VMEM_LIMIT_MB is read at each kernel build,
+    not once at import."""
+    from pystella_tpu.ops import pallas_stencil as psten
+    monkeypatch.setenv("PYSTELLA_VMEM_LIMIT_MB", "48")
+    assert psten.vmem_limit_bytes() == 48 * 2**20
+    params = psten._compiler_params(interpret=False)
+    assert params.vmem_limit_bytes == 48 * 2**20
+    monkeypatch.setenv("PYSTELLA_VMEM_LIMIT_MB", "64")
+    assert psten._compiler_params(False).vmem_limit_bytes == 64 * 2**20
+    assert psten._compiler_params(True) is None  # interpret mode
+
+
+def test_bench_auto_assemble_uses_local_volume(make_decomp):
+    """Satellite: the GW bench's assemble='update' auto-default keys on
+    PER-DEVICE volume, so multi-chip decomps with comfortably-fitting
+    blocks keep the faster concat assembly."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import sys
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    bench = importlib.import_module("bench")
+    single = make_decomp((1, 1, 1))
+    multi = make_decomp((2, 2, 1))
+    assert bench.auto_assemble(single, (512, 512, 512)) == "update"
+    assert bench.auto_assemble(multi, (512, 512, 512)) == "concat"
+    assert bench.auto_assemble(single, (128, 128, 128)) == "concat"
+
+
+def test_multigrid_cycle_emits_event(event_log, make_decomp):
+    """One tiny FAS V-cycle logs an mg_cycle event with final errors and
+    bumps the cycle counters."""
+    from pystella_tpu.multigrid import (
+        FullApproximationScheme, NewtonIterator, v_cycle)
+    decomp = make_decomp((1, 1, 1))
+    dtype = np.float64
+    n = 16
+    f = ps.Field("f")
+    solver = NewtonIterator(
+        decomp, {f: (ps.Field("lap_f") - f, ps.Field("rho"))},
+        halo_shape=1, omega=2 / 3, dtype=dtype)
+    mg = FullApproximationScheme(solver=solver, halo_shape=1)
+    rng = np.random.default_rng(5)
+    rho_np = rng.standard_normal((n, n, n)).astype(dtype)
+    rho = decomp.shard(rho_np - rho_np.mean())
+    before = metrics.counter("mg_cycles").value
+    errors, sol = mg(decomp, dx0=1.0, cycle=v_cycle(2, 2, 1),
+                     f=decomp.zeros((n, n, n), dtype), rho=rho)
+    assert metrics.counter("mg_cycles").value == before + 1
+    evs = events.read_events(event_log, kind="mg_cycle")
+    assert len(evs) == 1
+    assert evs[0]["data"]["grid_shape"] == [n, n, n]
+    assert "f" in evs[0]["data"]["final_errors"]
