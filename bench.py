@@ -45,7 +45,17 @@ under the external harness's observed kill timeout), BENCH_DIAL_BUDGET
 config once the device is up, default 300), BENCH_EXTRAS=0 to skip the
 secondary config matrix, BENCH_FORCE_CPU=1 to skip TPU attempts,
 BENCH_CPU_FIRST=0 to skip the labeled CPU insurance number captured
-before the TPU attempts, BENCH_NO_CACHE=1 to ignore persisted lines.
+before the TPU attempts, BENCH_NO_CACHE=1 to ignore persisted lines,
+BENCH_PROFILE=<logdir> to wrap each preheat timing window in a
+``jax.profiler`` capture whose per-scope durations land in the event
+log as ``trace_summary`` events (doc/observability.md).
+
+``python bench.py --smoke`` is a different animal: a tiny,
+deterministic, CPU-safe in-process run that exercises the full perf
+EVIDENCE pipeline — per-step ``step_time`` events, a profiler capture
+parsed into per-scope durations, and a ``PerfLedger`` written to
+``bench_results/perf_report.json`` + ``.md`` — so CI can smoke → gate
+(``python -m pystella_tpu.obs.gate``) end to end without hardware.
 """
 
 import json
@@ -308,6 +318,21 @@ def run_preheat(n, nsteps=10, dtype=np.float32, fused="auto"):
     state = chunk(state)
     sync(state)
     elapsed = time.perf_counter() - start
+
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        # capture a SEPARATE extra chunk (outside the timed window —
+        # tracing overhead must not contaminate the reported number);
+        # the parsed per-scope durations land in the event log as a
+        # trace_summary event (obs.trace), the perf ledger's breakdown
+        # for this config
+        from pystella_tpu.obs import trace as obs_trace
+        hb(f"{n}^3 ({label}): profiling one extra chunk")
+        with obs_trace.capture(
+                os.path.join(profile_dir, f"preheat-{n}-{label}"),
+                label=f"preheat-{n}^3 ({label})"):
+            state = chunk(state)
+            sync(state)
 
     sites = float(n) ** 3
     ups = sites * nsteps / elapsed
@@ -710,6 +735,102 @@ def run_multigrid(n=512, ncycles=2):
 
 
 # ---------------------------------------------------------------------------
+# smoke: tiny deterministic in-process run of the full evidence pipeline
+# ---------------------------------------------------------------------------
+
+def run_smoke(argv=None):
+    """``python bench.py --smoke``: exercise the whole perf evidence
+    pipeline on a tiny deterministic grid (CPU-safe, ~seconds).
+
+    Produces under ``--out`` (default ``bench_results/``):
+
+    - ``smoke_events.jsonl`` — the structured run record (per-step
+      ``step_time`` events, the step executable's ``compile`` report,
+      a ``trace_summary`` from a real ``jax.profiler`` capture);
+    - ``perf_report.json`` + ``perf_report.md`` — the
+      :class:`pystella_tpu.obs.ledger.PerfLedger` output the regression
+      gate consumes.
+
+    This is pipeline-integrity evidence, not a performance claim: the
+    generic XLA path on whatever backend is present, fixed seeds, fixed
+    step count. CI runs smoke → ``python -m pystella_tpu.obs.gate``
+    end to end (tests/test_gate.py).
+    """
+    import argparse
+    p = argparse.ArgumentParser(prog="bench.py --smoke")
+    p.add_argument("--grid", type=int, default=32)
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_results"))
+    p.add_argument("--no-profile", action="store_true",
+                   help="skip the jax.profiler capture (the report's "
+                        "scope table is then empty)")
+    args = p.parse_args(argv)
+
+    import contextlib
+
+    import jax
+    import pystella_tpu as ps
+    from pystella_tpu import obs
+
+    os.makedirs(args.out, exist_ok=True)
+    events_path = os.path.join(args.out, "smoke_events.jsonl")
+    # fresh record per smoke run: the ledger must describe THIS run,
+    # not an accumulation of prior ones
+    if os.path.exists(events_path):
+        os.remove(events_path)
+    obs.configure(events_path)
+
+    n = args.grid
+    grid_shape = (n, n, n)
+    hb(f"smoke: {n}^3 generic path, {args.steps} steps, "
+       f"backend={jax.default_backend()}")
+    obs.emit("bench_run", mode="smoke", grid_shape=list(grid_shape),
+             nsteps=args.steps)
+
+    t = np.float32(0.0)
+    stepper, state, dt = build_preheat_step(grid_shape, fused=False)
+    rhs_args = {"a": np.float32(1.0), "hubble": np.float32(0.5)}
+    compiled, rec = obs.compile_with_report(
+        stepper._jit_step, state, t, dt, rhs_args, label="smoke_step")
+    hb(f"smoke: compiled in {rec.compile_seconds:.2f}s "
+       f"(arg+out bytes {((rec.argument_bytes or 0) + (rec.output_bytes or 0)):,})")
+    for _ in range(2):
+        state = compiled(state, t, dt, rhs_args)
+    sync(state)
+
+    steptimer = ps.StepTimer(report_every=float("inf"), emit_steps=True)
+    capture = (contextlib.nullcontext() if args.no_profile else
+               obs.trace.capture(os.path.join(args.out, "smoke_trace"),
+                                 label="smoke"))
+    with capture:
+        steptimer.tick()  # arm the clock
+        for _ in range(args.steps):
+            with obs.trace_scope("bench_step"):
+                state = compiled(state, t, dt, rhs_args)
+                sync(state)
+            steptimer.tick()
+
+    ledger = obs.PerfLedger.from_events(
+        events_path, registry=obs.registry(), label=f"smoke-{n}^3",
+        step_label="smoke_step")
+    report_path = ledger.write(args.out)
+    rep = ledger.report()
+    st = rep["steps"]
+    hb(f"smoke: p50 {st['p50_ms']:.3f} ms/step (MAD {st['mad_ms']:.3f}), "
+       f"{len(rep['scopes'])} scope(s) in breakdown -> {report_path}")
+    # stdout metric line + event, via the SMOKE event log (not the
+    # orchestrator's long-lived run_events.jsonl — smoke is self-contained)
+    metric = (f"smoke p50 ms/step ({n}^3 preheating, generic, "
+              f"{jax.default_backend()})")
+    print(json.dumps({"metric": metric, "value": st["p50_ms"],
+                      "unit": "ms/step", "vs_baseline": None}), flush=True)
+    obs.emit("bench_metric", metric=metric, value=st["p50_ms"],
+             unit="ms/step")
+    return report_path
+
+
+# ---------------------------------------------------------------------------
 # payload: runs in a SUBPROCESS holding the device for all configs
 # ---------------------------------------------------------------------------
 
@@ -1045,5 +1166,7 @@ def main():
 if __name__ == "__main__":
     if "--payload" in sys.argv:
         payload(sys.argv[sys.argv.index("--payload") + 1])
+    elif "--smoke" in sys.argv:
+        run_smoke([a for a in sys.argv[1:] if a != "--smoke"])
     else:
         main()

@@ -15,10 +15,15 @@ regression signal for accidental replication, not as a hardware claim.
 
 Prints one JSON line per mesh size and a final efficiency line.
 
-Usage: ``python bench_scaling.py [--local 64] [--devices 1,2,4,8]``
-(set ``PYSTELLA_BENCH_PLATFORM=tpu`` to dial hardware).
+Usage: ``python bench_scaling.py [--local 64] [--devices 1,2,4,8]
+[--profile DIR]`` (set ``PYSTELLA_BENCH_PLATFORM=tpu`` to dial
+hardware). ``--profile`` wraps the LARGEST mesh's timed window in a
+``jax.profiler`` capture; the parsed per-scope durations land in the
+run-event log (``PYSTELLA_EVENT_LOG``) as a ``trace_summary`` event —
+the at-scale halo-exchange/stencil breakdown the perf ledger cites.
 """
 
+import contextlib
 import json
 import os
 import sys
@@ -49,8 +54,20 @@ def _factor2(n):
     return best
 
 
+def _profiled_extra_window(profile_dir, tag, body):
+    """Run ``body()`` once under a jax.profiler capture (a SEPARATE,
+    untimed window: tracing overhead must never sit inside the measured
+    loop — it would bias the efficiency ratio for whichever mesh gets
+    profiled)."""
+    if not profile_dir:
+        return
+    from pystella_tpu.obs import trace as obs_trace
+    with obs_trace.capture(os.path.join(profile_dir, tag), label=tag):
+        body()
+
+
 def run_mesh(ndev, local_n, nsteps=10, nwarmup=2, dtype=np.float32,
-             system="scalar"):
+             system="scalar", profile_dir=None):
     import pystella_tpu as ps
 
     if system == "gw":
@@ -116,6 +133,12 @@ def run_mesh(ndev, local_n, nsteps=10, nwarmup=2, dtype=np.float32,
         state = chunk(state)
         jax.block_until_ready(state)
         ms = (time.perf_counter() - start) / nsteps * 1e3
+
+        def _profiled_chunk():
+            with ps.obs.trace_scope("bench_step"):
+                jax.block_until_ready(chunk(state))
+        _profiled_extra_window(profile_dir, f"coupled-{ndev}dev",
+                               _profiled_chunk)
         return ms, float(np.prod(grid_shape))
 
     args = {"a": dtype(1.0), "hubble": dtype(0.5)}
@@ -132,6 +155,17 @@ def run_mesh(ndev, local_n, nsteps=10, nwarmup=2, dtype=np.float32,
         state = step(state)
     jax.block_until_ready(state)
     ms = (time.perf_counter() - start) / nsteps * 1e3
+
+    def _profiled_steps():
+        s = state
+        for _ in range(nsteps):
+            # host-side span per step: even a CPU capture (no device
+            # rows) then yields a non-empty per-scope table
+            with ps.obs.trace_scope("bench_step"):
+                s = step(s)
+        jax.block_until_ready(s)
+    _profiled_extra_window(profile_dir, f"{system}-{ndev}dev",
+                           _profiled_steps)
     return ms, float(np.prod(grid_shape))
 
 
@@ -148,6 +182,9 @@ def main():
     if "--system" in argv:
         system = argv[argv.index("--system") + 1]
         assert system in ("scalar", "gw", "coupled"), system
+    profile_dir = None
+    if "--profile" in argv:
+        profile_dir = argv[argv.index("--profile") + 1]
     navail = len(jax.devices())
     if dev_counts is None:
         dev_counts = [d for d in (1, 2, 4, 8, 16, 32, 64) if d <= navail]
@@ -165,7 +202,11 @@ def main():
     sysname = "" if system == "scalar" else f" {system}"
     times = {}
     for ndev in dev_counts:
-        ms, sites = run_mesh(ndev, local_n, system=system)
+        # profile only the largest mesh: that's the configuration whose
+        # halo/stencil breakdown the scaling claim rests on
+        ms, sites = run_mesh(
+            ndev, local_n, system=system,
+            profile_dir=profile_dir if ndev == max(dev_counts) else None)
         times[ndev] = ms
         print(json.dumps({
             "metric": f"weak-scaling{sysname} {ndev} dev "

@@ -8,6 +8,7 @@ evolution via the Friedmann equations, energy reductions, power spectra,
 histograms, and provenance-rich HDF5 output — over a sharded device mesh.
 """
 
+import os
 from argparse import ArgumentParser
 
 import numpy as np
@@ -77,15 +78,34 @@ parser.add_argument("--event-log", type=str, default=None,
                     metavar="PATH", help="structured JSONL run-event log"
                     " (doc/observability.md); PYSTELLA_EVENT_LOG also"
                     " works")
+parser.add_argument("--profile", type=str, default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of a step window"
+                    " under DIR; the parsed per-scope durations are"
+                    " emitted as a trace_summary run event")
+parser.add_argument("--profile-start", type=int, default=10,
+                    metavar="STEP", help="first profiled step (leave"
+                    " room for jit compilation to finish)")
+parser.add_argument("--profile-steps", type=int, default=20, metavar="N",
+                    help="length of the profiled step window")
+parser.add_argument("--perf-report", type=str, default=None,
+                    metavar="DIR", help="at run end, digest the event"
+                    " log + metrics registry into perf_report.json/.md"
+                    " under DIR (requires --event-log or"
+                    " PYSTELLA_EVENT_LOG)")
 
 
 def main(argv=None):
     import jax
     p = parser.parse_args(argv)
     if p.event_log is not None:
-        # HealthMonitor divergences, checkpoint saves/restores, and
-        # StepTimer reports then all land in one greppable record
+        # HealthMonitor divergences, checkpoint saves/restores, per-step
+        # timings, and StepTimer reports then all land in one greppable
+        # record
         ps.obs.configure(p.event_log)
+    if p.perf_report is not None and p.event_log is None \
+            and not os.environ.get("PYSTELLA_EVENT_LOG"):
+        raise ValueError("--perf-report digests the event log: pass "
+                         "--event-log (or set PYSTELLA_EVENT_LOG)")
     p.grid_shape = tuple(p.grid_shape)
     p.proc_shape = tuple(p.proc_shape)
     p.box_dim = tuple(p.box_dim)
@@ -290,50 +310,75 @@ def main(argv=None):
                 gravitational_waves=p.gravitational_waves,
                 chunk_steps=p.chunk_steps)
 
-    steptimer = ps.StepTimer(report_every=30.0)
+    # per-step step_time events cost nothing when no event log is
+    # configured, and give the PerfLedger its step-time distribution
+    # when one is (--event-log / PYSTELLA_EVENT_LOG)
+    steptimer = ps.StepTimer(report_every=30.0, emit_steps=True)
     # check at least as often as checkpoints are written so a diverged
     # state is never saved
     monitor = ps.HealthMonitor(every=50)
 
+    # --profile: jax.profiler capture of a mid-run step window (entered
+    # once compilation has settled), parsed into per-scope durations on
+    # exit (obs.trace.capture emits the trace_summary event)
+    profiler = None
+    profile_begin = None
+    profile_done = p.profile is None
+
     carry = None
     try:
         while t < p.end_time and expand.a < p.end_scale_factor:
-            if p.chunk_steps:
-                # chunked hot loop: one device dispatch per N steps
-                n = p.chunk_steps
-                if p.chunk_mode == "coupled":
-                    # expansion ODE integrated on device, exact
-                    # per-stage energy feedback (in-kernel reductions)
-                    pair = {"auto": None, "on": True,
-                            "off": False}[p.chunk_pair]
-                    state = stepper.coupled_multi_step(
-                        state, n, expand, t, dt, grid_size=p.grid_size,
-                        pair=pair)
-                else:
-                    # frozen-rho: host-precomputed background (see
-                    # --chunk-mode help for the accuracy price)
-                    a_seq, hubble_seq = expand.stage_sequence(
-                        n, energy["total"], energy["pressure"], dt)
-                    state = stepper.multi_step(
-                        state, n, t, dt,
-                        rhs_seq={"a": a_seq, "hubble": hubble_seq})
-                energy = compute_energy(state, expand.a)
-                t += n * dt
-                step_count += n
-            else:
-                for s in range(stepper.num_stages):
-                    carry = stepper(s, state if s == 0 else carry, t,
-                                    a=np.float64(expand.a),
-                                    hubble=np.float64(expand.hubble))
-                    expand.step(s, energy["total"], energy["pressure"], dt)
-                    if s == stepper.num_stages - 1:
-                        state = carry
-                        energy = compute_energy(state, expand.a)
+            if not profile_done and profiler is None \
+                    and step_count >= p.profile_start:
+                jax.block_until_ready(state)
+                profiler = ps.obs.trace.capture(
+                    p.profile, label="scalar_preheating", step=step_count)
+                profiler.__enter__()
+                profile_begin = step_count
+            with ps.obs.trace_scope("driver_step"):
+                if p.chunk_steps:
+                    # chunked hot loop: one device dispatch per N steps
+                    n = p.chunk_steps
+                    if p.chunk_mode == "coupled":
+                        # expansion ODE integrated on device, exact
+                        # per-stage energy feedback (in-kernel
+                        # reductions)
+                        pair = {"auto": None, "on": True,
+                                "off": False}[p.chunk_pair]
+                        state = stepper.coupled_multi_step(
+                            state, n, expand, t, dt,
+                            grid_size=p.grid_size, pair=pair)
                     else:
-                        energy = compute_energy(stepper.current(carry),
-                                                expand.a)
-                t += dt
-                step_count += 1
+                        # frozen-rho: host-precomputed background (see
+                        # --chunk-mode help for the accuracy price)
+                        a_seq, hubble_seq = expand.stage_sequence(
+                            n, energy["total"], energy["pressure"], dt)
+                        state = stepper.multi_step(
+                            state, n, t, dt,
+                            rhs_seq={"a": a_seq, "hubble": hubble_seq})
+                    energy = compute_energy(state, expand.a)
+                    t += n * dt
+                    step_count += n
+                else:
+                    for s in range(stepper.num_stages):
+                        carry = stepper(s, state if s == 0 else carry, t,
+                                        a=np.float64(expand.a),
+                                        hubble=np.float64(expand.hubble))
+                        expand.step(s, energy["total"],
+                                    energy["pressure"], dt)
+                        if s == stepper.num_stages - 1:
+                            state = carry
+                            energy = compute_energy(state, expand.a)
+                        else:
+                            energy = compute_energy(
+                                stepper.current(carry), expand.a)
+                    t += dt
+                    step_count += 1
+            if profiler is not None and not profile_done \
+                    and step_count - profile_begin >= p.profile_steps:
+                jax.block_until_ready(state)
+                profiler.__exit__(None, None, None)
+                profiler, profile_done = None, True
             output(step_count, t, energy, expand, state)
             # a NaN state must never be checkpointed: saves happen exactly
             # on the requested interval, each preceded by a health check
@@ -377,9 +422,18 @@ def main(argv=None):
         constraint = expand.constraint(energy["total"])
         if out is not None:
             out.file.attrs["final_constraint"] = constraint
+    except BaseException as e:
+        # the forensic tail of the run record: what killed the loop and
+        # exactly when (HealthMonitor's diverged event, if any, directly
+        # precedes this one)
+        ps.obs.emit("run_aborted", step=step_count, t=t,
+                    error=f"{type(e).__name__}: {e}")
+        raise
     finally:
         # finalize persistence even on divergence/interrupt so the last
         # good checkpoint and the HDF5 series survive
+        if profiler is not None:
+            profiler.__exit__(None, None, None)
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()
@@ -391,6 +445,14 @@ def main(argv=None):
         print(f"final constraint: {constraint:.16e}")
     ps.obs.emit("run_complete", step=step_count, t=t,
                 a=float(expand.a), constraint=float(constraint))
+    if p.perf_report is not None:
+        # digest this run's record into the evidence artifact the
+        # regression gate consumes (python -m pystella_tpu.obs.gate)
+        ledger = ps.obs.PerfLedger.from_events(
+            ps.obs.get_log().path, registry=ps.obs.registry(),
+            label="scalar_preheating", sites=int(p.grid_size))
+        if decomp.rank == 0:
+            print(f"perf report: {ledger.write(p.perf_report)}")
     return constraint
 
 

@@ -25,6 +25,24 @@ One subsystem behind the pieces that grew up scattered (``utils/monitor``,
   live device-memory reports (the evidence that catches an HBM
   overshoot *before* Mosaic or the allocator rejects it).
 
+The PERF EVIDENCE PIPELINE (PR 2) sits on top — emission above, analysis
+below, so a throughput claim is a distribution with provenance instead
+of one wall-clock number:
+
+- :mod:`pystella_tpu.obs.trace` — ``jax.profiler`` capture around a
+  step window plus a stdlib Perfetto-trace parser that recovers
+  per-scope durations for the names ``obs.scope`` threaded through the
+  hot paths, emitted as ``trace_summary`` events.
+- :mod:`pystella_tpu.obs.ledger` — :class:`~pystella_tpu.obs.ledger.
+  PerfLedger` ingests the event log + metrics registry into
+  ``bench_results/perf_report.json`` / ``.md``: step-time percentiles
+  and MAD, per-scope breakdown, site-updates/s, roofline fraction, and
+  an environment fingerprint.
+- :mod:`pystella_tpu.obs.gate` — the noise-aware regression gate CLI
+  (``python -m pystella_tpu.obs.gate``): ``median +- k*MAD`` comparison
+  plus a contamination detector; exits nonzero on regression or invalid
+  evidence so CI can consume it.
+
 See ``doc/observability.md`` for the event schema and driver recipes.
 """
 
@@ -37,6 +55,13 @@ from pystella_tpu.obs.scope import (
 from pystella_tpu.obs.memory import (
     CompileRecord, compile_with_report, device_memory_report,
     device_memory_stats)
+# obs.gate is deliberately NOT imported here: its primary entry point is
+# ``python -m pystella_tpu.obs.gate``, and runpy warns when the module
+# is already in sys.modules at -m execution time. Import it explicitly
+# (``from pystella_tpu.obs import gate``) for programmatic use.
+from pystella_tpu.obs import ledger, trace
+from pystella_tpu.obs.ledger import PerfLedger, environment_fingerprint
+from pystella_tpu.obs.trace import scope_durations, summarize_trace
 
 __all__ = [
     "EventLog", "configure", "emit", "get_log", "read_events",
@@ -45,4 +70,7 @@ __all__ = [
     "trace_scope", "traced", "lowered_scopes", "has_scope",
     "CompileRecord", "compile_with_report",
     "device_memory_report", "device_memory_stats",
+    "trace", "ledger",
+    "PerfLedger", "environment_fingerprint",
+    "scope_durations", "summarize_trace",
 ]

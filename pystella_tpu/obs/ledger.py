@@ -1,0 +1,403 @@
+"""PerfLedger: run events + metrics -> a defensible perf report.
+
+Round 5's verdict was that the headline throughput claim rested on
+"zero valid measurements": single wall-clock numbers, no noise model,
+no environment provenance, one contaminated run flagged by hand. The
+ledger is the analysis layer that turns the PR-1 telemetry (the JSONL
+run-event log plus the metrics registry) into evidence the way the
+stencil-compiler literature justifies results — distributions and
+roofline fractions, not a lone number:
+
+- **step-time distribution** — per-step wall-time samples (from
+  ``step_time`` events, falling back to ``step_timer`` window reports)
+  summarized as percentiles, mean, and MAD (median absolute deviation —
+  the robust noise scale the regression gate's ``median +- k*MAD``
+  comparison needs);
+- **per-scope breakdown** — the latest ``trace_summary`` event's
+  per-scope duration table (:mod:`pystella_tpu.obs.trace`);
+- **derived throughput** — site-updates/s from the lattice volume in
+  the run-metadata event and the median step time;
+- **roofline fraction** — bytes moved per step from the step
+  executable's ``compile`` event (XLA ``memory_analysis()`` argument +
+  output bytes, a traffic lower bound) over the step time, against the
+  device's peak HBM bandwidth;
+- **environment fingerprint** — jax/jaxlib versions, device kind and
+  count, process count, mesh shape, hostname: the provenance that makes
+  two reports comparable at all.
+
+``PerfLedger.write(dir)`` produces ``perf_report.json`` (schema below,
+consumed by :mod:`pystella_tpu.obs.gate`) and a human ``perf_report.md``.
+The module body never requires jax at runtime — versions come from
+package metadata and device fields degrade to ``None`` when no jax is
+loaded (importing it as ``pystella_tpu.obs.ledger`` still pulls jax via
+the package ``__init__``; a jax-free supervisor should load it by file,
+like ``bench.py`` loads ``obs/events.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform as _platform
+import socket
+import sys
+import time
+
+from pystella_tpu.obs import events as _events
+
+__all__ = ["REPORT_SCHEMA_VERSION", "PerfLedger", "environment_fingerprint",
+           "mad", "percentile", "step_stats"]
+
+REPORT_SCHEMA_VERSION = 1
+
+#: peak HBM bandwidth per device generation, GB/s (vendor figures; keys
+#: are matched as substrings of ``device_kind``, longest first). Used
+#: for the roofline denominator; unknown kinds (CPU included) yield a
+#: ``None`` fraction rather than a made-up one.
+HBM_PEAK_GBPS = {
+    "TPU v2": 700.0,
+    "TPU v3": 900.0,
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
+
+#: cap on raw samples persisted into the report: enough for the gate's
+#: contamination detector to see bursts, small enough to keep reports
+#: reviewable in a diff
+MAX_SAMPLES = 4096
+
+
+def _version_of(dist):
+    try:
+        from importlib.metadata import version
+        return version(dist)
+    except Exception:
+        return None
+
+
+def environment_fingerprint():
+    """Everything needed to decide whether two perf reports are
+    comparable. Resolved from an already-imported jax only (the module
+    must stay importable in the jax-free orchestrator); device fields
+    are ``None`` when jax is not loaded."""
+    env = {
+        "python": _platform.python_version(),
+        "jax": _version_of("jax"),
+        "jaxlib": _version_of("jaxlib"),
+        "hostname": socket.gethostname(),
+        "platform": None,
+        "device_kind": None,
+        "num_devices": None,
+        "num_processes": None,
+    }
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            devs = jax.devices()
+            env["platform"] = devs[0].platform
+            env["device_kind"] = devs[0].device_kind
+            env["num_devices"] = len(devs)
+            env["num_processes"] = int(jax.process_count())
+        except Exception:
+            pass
+    return env
+
+
+def percentile(sorted_xs, q):
+    """Linear-interpolation percentile of an already-sorted list
+    (``q`` in [0, 100])."""
+    if not sorted_xs:
+        return None
+    if len(sorted_xs) == 1:
+        return float(sorted_xs[0])
+    pos = q / 100.0 * (len(sorted_xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_xs) - 1)
+    frac = pos - lo
+    return float(sorted_xs[lo] * (1 - frac) + sorted_xs[hi] * frac)
+
+
+def mad(xs):
+    """Median absolute deviation — the robust noise scale. (Multiply by
+    1.4826 for a Gaussian-consistent sigma; the gate does.)"""
+    if not xs:
+        return None
+    s = sorted(xs)
+    med = percentile(s, 50)
+    return percentile(sorted(abs(x - med) for x in s), 50)
+
+
+def step_stats(samples_ms):
+    """Distribution summary of per-step wall times (ms)."""
+    if not samples_ms:
+        return {"count": 0}
+    s = sorted(samples_ms)
+    return {
+        "count": len(s),
+        "mean_ms": sum(s) / len(s),
+        "min_ms": s[0],
+        "max_ms": s[-1],
+        "p10_ms": percentile(s, 10),
+        "p50_ms": percentile(s, 50),
+        "p90_ms": percentile(s, 90),
+        "p99_ms": percentile(s, 99),
+        "mad_ms": mad(s),
+    }
+
+
+def _peak_gbps(device_kind):
+    if not device_kind:
+        return None
+    for key in sorted(HBM_PEAK_GBPS, key=len, reverse=True):
+        if key in device_kind:
+            return HBM_PEAK_GBPS[key]
+    return None
+
+
+class PerfLedger:
+    """Aggregates one run's telemetry into a perf report.
+
+    Build with :meth:`from_events` (the normal path: ingest a
+    ``run_events.jsonl`` plus the live metrics registry), or construct
+    directly and feed :meth:`add_step_ms` / attributes for synthetic
+    ledgers in tests.
+    """
+
+    def __init__(self, label="", sites=None, env=None):
+        self.label = label
+        self.sites = sites              # lattice sites updated per step
+        self.env = env or environment_fingerprint()
+        self.samples_ms = []            # per-step wall times
+        self.scopes = {}                # trace-derived per-scope table
+        self.trace_file = None
+        self.bytes_per_step = None      # HBM traffic lower bound
+        self.compile_records = []       # compile-event payloads
+        self.metrics = {}               # registry snapshot
+        self.meta = {}                  # run-metadata event payload
+
+    # -- ingestion ---------------------------------------------------------
+
+    def add_step_ms(self, ms):
+        self.samples_ms.append(float(ms))
+
+    @classmethod
+    def from_events(cls, events_path, registry=None, label="",
+                    sites=None, step_label=None):
+        """Ingest a run-event JSONL file (and optionally the live
+        metrics registry).
+
+        - per-step samples: ``step_time`` events (``data.ms``); when a
+          run only kept ``step_timer`` window reports, those window
+          averages stand in (coarser, still gateable);
+        - lattice sites: explicit ``sites`` arg, else the grid shape in
+          the latest ``run_start`` / ``bench_run`` event;
+        - scope table: the latest ``trace_summary`` event;
+        - bytes/step: the ``compile`` event labeled ``step_label`` (or
+          the largest-argument one), argument + output bytes.
+
+        :class:`~pystella_tpu.obs.events.EventLog` appends, so a reused
+        log file holds several runs; ingestion is scoped to the LATEST
+        run — everything from the last ``run_start``/``bench_run``
+        event on — so a report never averages two runs' step times
+        together (a regression between them would vanish into the mix).
+        A log with no run-metadata event is ingested whole.
+        """
+        led = cls(label=label, sites=sites)
+        window_ms = []
+        all_events = _events.read_events(events_path)
+        starts = [i for i, ev in enumerate(all_events)
+                  if ev.get("kind") in ("run_start", "bench_run")]
+        if starts:
+            all_events = all_events[starts[-1]:]
+        for ev in all_events:
+            kind = ev.get("kind")
+            data = ev.get("data") or {}
+            if kind == "step_time" and isinstance(
+                    data.get("ms"), (int, float)):
+                led.samples_ms.append(float(data["ms"]))
+            elif kind == "step_timer" and isinstance(
+                    data.get("ms_per_step"), (int, float)):
+                window_ms.append(float(data["ms_per_step"]))
+            elif kind == "trace_summary":
+                led.scopes = data.get("scopes") or {}
+                led.trace_file = data.get("trace_file")
+            elif kind == "compile":
+                led.compile_records.append(data)
+            elif kind in ("run_start", "bench_run"):
+                led.meta = data
+        if not led.samples_ms and window_ms:
+            led.samples_ms = window_ms
+        if led.sites is None:
+            shape = led.meta.get("grid_shape")
+            if isinstance(shape, (list, tuple)) and shape:
+                sites = 1
+                for d in shape:
+                    sites *= int(d)
+                led.sites = sites
+        led._pick_step_compile(step_label)
+        if registry is not None:
+            try:
+                led.metrics = registry.snapshot()
+            except Exception:
+                led.metrics = {}
+        return led
+
+    def _pick_step_compile(self, step_label=None):
+        """Bytes moved per step from the step executable's compile
+        record: arguments read + outputs written is the floor on HBM
+        traffic for one call. Prefers the record labeled ``step_label``;
+        otherwise the one with the largest argument footprint (the step
+        computation dominates any helper compiles)."""
+        recs = [r for r in self.compile_records
+                if isinstance(r.get("argument_bytes"), (int, float))]
+        if not recs:
+            return
+        if step_label is not None:
+            labeled = [r for r in recs if r.get("label") == step_label]
+            recs = labeled or recs
+        rec = max(recs, key=lambda r: r["argument_bytes"])
+        out = rec.get("output_bytes")
+        self.bytes_per_step = int(rec["argument_bytes"]) + int(out or 0)
+
+    # -- derived quantities ------------------------------------------------
+
+    def stats(self):
+        return step_stats(self.samples_ms)
+
+    def site_updates_per_s(self):
+        st = self.stats()
+        if not self.sites or not st.get("p50_ms"):
+            return None
+        return float(self.sites) * 1e3 / st["p50_ms"]
+
+    def roofline(self):
+        """Achieved HBM bandwidth (bytes/step over median step time)
+        and its fraction of the device peak; fields are ``None`` when
+        the inputs (compile bytes, step times, a known device kind) are
+        missing."""
+        st = self.stats()
+        achieved = None
+        if self.bytes_per_step and st.get("p50_ms"):
+            achieved = self.bytes_per_step / (st["p50_ms"] / 1e3) / 1e9
+        peak = _peak_gbps(self.env.get("device_kind"))
+        frac = achieved / peak if achieved and peak else None
+        return {"bytes_per_step": self.bytes_per_step,
+                "achieved_gbps": achieved,
+                "peak_gbps": peak,
+                "fraction_of_peak": frac}
+
+    # -- report ------------------------------------------------------------
+
+    def report(self):
+        """The JSON-safe report dict (``perf_report.json`` schema v1;
+        doc/observability.md documents every field)."""
+        return {
+            "schema": REPORT_SCHEMA_VERSION,
+            "generated_ts": time.time(),
+            "label": self.label,
+            "env": self.env,
+            "run": self.meta,
+            "steps": self.stats(),
+            "samples_ms": [round(x, 6)
+                           for x in self.samples_ms[-MAX_SAMPLES:]],
+            "throughput": {
+                "sites": self.sites,
+                "site_updates_per_s": self.site_updates_per_s(),
+            },
+            "roofline": self.roofline(),
+            "scopes": self.scopes,
+            "trace_file": self.trace_file,
+            "metrics": self.metrics,
+        }
+
+    def write(self, out_dir, stem="perf_report"):
+        """Write ``<stem>.json`` + ``<stem>.md`` under ``out_dir``;
+        returns the JSON path. Also emits a ``perf_report`` run event
+        pointing at it, so the event log records which report a run
+        produced."""
+        os.makedirs(out_dir, exist_ok=True)
+        rep = self.report()
+        json_path = os.path.join(out_dir, stem + ".json")
+        with open(json_path, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+            f.write("\n")
+        with open(os.path.join(out_dir, stem + ".md"), "w") as f:
+            f.write(render_markdown(rep))
+        _events.emit("perf_report", path=json_path, label=self.label)
+        return json_path
+
+
+def _fmt(x, spec=".4g", none="—"):
+    return format(x, spec) if isinstance(x, (int, float)) else none
+
+
+def render_markdown(rep):
+    """Human rendering of a report dict (the ``perf_report.md`` body)."""
+    env = rep.get("env", {})
+    st = rep.get("steps", {})
+    tp = rep.get("throughput", {})
+    rf = rep.get("roofline", {})
+    lines = [
+        f"# Perf report — {rep.get('label') or 'unlabeled run'}",
+        "",
+        "Generated "
+        + time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                        time.gmtime(rep.get("generated_ts", 0)))
+        + f" · schema v{rep.get('schema')}",
+        "",
+        "## Environment",
+        "",
+        f"- jax {env.get('jax')} / jaxlib {env.get('jaxlib')}, "
+        f"python {env.get('python')}",
+        f"- platform `{env.get('platform')}`, device kind "
+        f"`{env.get('device_kind')}`, {env.get('num_devices')} device(s), "
+        f"{env.get('num_processes')} process(es), "
+        f"host `{env.get('hostname')}`",
+        "",
+        "## Step-time distribution",
+        "",
+        f"{st.get('count', 0)} steps: "
+        f"p50 {_fmt(st.get('p50_ms'))} ms, p90 {_fmt(st.get('p90_ms'))} ms, "
+        f"p99 {_fmt(st.get('p99_ms'))} ms, MAD {_fmt(st.get('mad_ms'))} ms "
+        f"(mean {_fmt(st.get('mean_ms'))}, min {_fmt(st.get('min_ms'))}, "
+        f"max {_fmt(st.get('max_ms'))})",
+        "",
+        "## Throughput",
+        "",
+        f"- sites/step: {_fmt(tp.get('sites'), ',.0f')}",
+        f"- site-updates/s (median step): "
+        f"{_fmt(tp.get('site_updates_per_s'), '.4e')}",
+        "",
+        "## Roofline",
+        "",
+        f"- bytes/step (XLA arg+out floor): "
+        f"{_fmt(rf.get('bytes_per_step'), ',.0f')}",
+        f"- achieved {_fmt(rf.get('achieved_gbps'))} GB/s of "
+        f"{_fmt(rf.get('peak_gbps'))} GB/s peak -> "
+        f"{_fmt(rf.get('fraction_of_peak'), '.1%')} of roofline",
+        "",
+        "## Per-scope breakdown",
+        "",
+    ]
+    scopes = rep.get("scopes") or {}
+    if scopes:
+        lines += ["| scope | count | total ms | mean ms |",
+                  "|---|---|---|---|"]
+        for name, row in sorted(
+                scopes.items(),
+                key=lambda kv: -kv[1].get("total_ms", 0.0)):
+            lines.append(
+                f"| `{name}` | {row.get('count')} "
+                f"| {_fmt(row.get('total_ms'))} "
+                f"| {_fmt(row.get('mean_ms'))} |")
+        if rep.get("trace_file"):
+            lines += ["", f"Trace: `{rep['trace_file']}`"]
+    else:
+        lines.append("*(no trace captured — per-scope durations "
+                     "unavailable; rerun with `--profile`)*")
+    lines.append("")
+    return "\n".join(lines)

@@ -160,13 +160,22 @@ class MetricsRegistry:
         """Reduce a sequence of per-host ``{name: value}`` snapshots
         into one fleet-wide dict using each metric's reduction. Exposed
         separately from :meth:`aggregate` so the reduction semantics are
-        testable without a multi-host cluster."""
+        testable without a multi-host cluster.
+
+        NaN entries are dropped before reducing: gauges are deliberately
+        pre-registered at NaN on every host (so the snapshot vectors
+        line up) and hosts cross their report cadences at different wall
+        times — one not-yet-reported host must not turn the fleet-wide
+        mean into NaN. A metric no host has set yet stays NaN."""
         ops = {k: op for k, (_, op) in self._exports().items()}
         out = {}
         for k in ops:
             vals = [s[k] for s in snapshots if k in s]
-            if vals:
-                out[k] = float(_REDUCERS[ops[k]](vals))
+            finite = [v for v in vals if not np.isnan(v)]
+            if finite:
+                out[k] = float(_REDUCERS[ops[k]](finite))
+            elif vals:
+                out[k] = float("nan")
         return out
 
     def aggregate(self):

@@ -1,0 +1,192 @@
+"""Profiler capture and Perfetto-trace analysis for the PR-1 scope names.
+
+PR 1 threaded :func:`pystella_tpu.obs.scope.trace_scope` names through
+every hot path (RK stages, halo exchange, Pallas stencils, multigrid
+smoothers); this module closes the loop by turning a captured trace back
+into *numbers* — per-scope durations the perf ledger can cite, instead
+of a screenshot of a timeline.
+
+Two halves:
+
+- :class:`capture` — a context manager around ``jax.profiler``
+  start/stop that, on exit, locates the emitted Perfetto
+  ``*.trace.json.gz``, parses it, and emits one ``trace_summary`` run
+  event carrying the per-scope duration table. Degrades gracefully: a
+  backend that produces no trace file (some CPU/interpret setups) emits
+  a ``trace_missing`` event and ``summary`` stays ``None`` — the
+  instrumented run never dies for lack of a profile.
+- the parser (:func:`find_trace_file`, :func:`parse_trace_file`,
+  :func:`scope_durations`) — stdlib-only (``gzip`` + ``json``), so the
+  jax-free bench orchestrator and offline analysis scripts can digest a
+  trace captured elsewhere.
+
+Matching semantics: a trace event belongs to the *longest* known scope
+name that appears in the event name at a token boundary (so host-side
+``TraceAnnotation`` spans named ``halo_exchange`` match exactly;
+device-op rows named ``jit(step)/fused_rk_stage_pair/fusion.3`` match
+``fused_rk_stage_pair`` and NOT its prefix ``fused_rk_stage``; the
+generic stepper's ``rk_stage0`` ... ``rk_stage4`` all fold into
+``rk_stage``). Nested scopes each keep their own wall time — per-scope
+totals may overlap and are reported as independent rows, not a
+partition of the window.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+
+from pystella_tpu.obs import events as _events
+
+__all__ = ["KNOWN_SCOPES", "capture", "find_trace_file",
+           "parse_trace_file", "scope_durations", "summarize_trace"]
+
+#: the PR-1 instrumentation vocabulary (doc/observability.md "Trace
+#: scopes") plus the driver-level spans the bench/smoke loops add.
+KNOWN_SCOPES = (
+    "rk_stage",
+    "fused_rk_stage", "fused_rk_stage_pair", "fused_rk_stage_energy",
+    "fused_coupled_pair",
+    "halo_exchange",
+    "pallas_stencil", "pallas_resident_stencil",
+    "mg_cycle", "mg_smooth", "mg_residual",
+    "bench_step", "driver_step",
+)
+
+
+def _scope_matchers(scopes):
+    """Longest-first ``(scope, compiled_regex)`` pairs. The boundary
+    rule: the scope name must not be preceded by an identifier char and
+    must not be followed by a lowercase letter or underscore — digits
+    ARE allowed after (``rk_stage0`` is an ``rk_stage`` span) but
+    ``fused_rk_stage_pair`` is not a ``fused_rk_stage`` span."""
+    out = []
+    for s in sorted(scopes, key=len, reverse=True):
+        out.append((s, re.compile(
+            r"(?<![A-Za-z0-9_])" + re.escape(s) + r"(?![a-z_])")))
+    return out
+
+
+def find_trace_file(logdir):
+    """Newest ``*.trace.json(.gz)`` under ``logdir`` (jax writes
+    ``<logdir>/plugins/profile/<run>/<host>.trace.json.gz``), or ``None``
+    when the capture produced nothing."""
+    hits = []
+    for pat in ("*.trace.json.gz", "*.trace.json"):
+        hits += glob.glob(os.path.join(logdir, "**", pat), recursive=True)
+    if not hits:
+        return None
+    return max(hits, key=os.path.getmtime)
+
+
+def parse_trace_file(path):
+    """The Perfetto/Chrome ``traceEvents`` list from a ``.json`` or
+    ``.json.gz`` trace file. Returns ``[]`` for unreadable or
+    schema-less files rather than raising — trace analysis is evidence
+    collection, not a correctness gate."""
+    try:
+        opener = gzip.open if str(path).endswith(".gz") else open
+        with opener(path, "rt") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    evs = data.get("traceEvents") if isinstance(data, dict) else None
+    return evs if isinstance(evs, list) else []
+
+
+def scope_durations(trace_events, scopes=KNOWN_SCOPES):
+    """Fold complete-span events (``ph == "X"``, microsecond ``dur``)
+    into ``{scope: {"count", "total_ms", "mean_ms", "min_ms",
+    "max_ms"}}`` for every known scope that appears. Each event counts
+    toward the longest matching scope only."""
+    matchers = _scope_matchers(scopes)
+    acc = {}
+    for ev in trace_events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        name = ev.get("name")
+        dur = ev.get("dur")
+        if not isinstance(name, str) or not isinstance(dur, (int, float)):
+            continue
+        for scope, rx in matchers:
+            if rx.search(name):
+                ms = dur / 1e3
+                a = acc.setdefault(scope, [0, 0.0, ms, ms])
+                a[0] += 1
+                a[1] += ms
+                a[2] = min(a[2], ms)
+                a[3] = max(a[3], ms)
+                break
+    return {scope: {"count": n, "total_ms": tot, "mean_ms": tot / n,
+                    "min_ms": lo, "max_ms": hi}
+            for scope, (n, tot, lo, hi) in sorted(acc.items())}
+
+
+def summarize_trace(logdir, scopes=KNOWN_SCOPES, label="", step=None,
+                    log=None):
+    """Parse the newest trace under ``logdir`` into a per-scope duration
+    table and emit it as one ``kind="trace_summary"`` run event
+    (``kind="trace_missing"`` when no trace file appeared — CPU or
+    interpret-mode captures sometimes produce none). Returns the summary
+    dict, or ``None`` when there was nothing to parse."""
+    sink = log if log is not None else _events.get_log()
+    path = find_trace_file(logdir)
+    if path is None:
+        sink.emit("trace_missing", step=step, logdir=str(logdir),
+                  label=label)
+        return None
+    table = scope_durations(parse_trace_file(path), scopes)
+    summary = {"trace_file": path, "label": label, "scopes": table}
+    sink.emit("trace_summary", step=step, **summary)
+    return summary
+
+
+class capture:
+    """``jax.profiler`` capture around a step window, with automatic
+    post-capture analysis.
+
+    Usage (the bench/example drivers' ``--profile`` flag)::
+
+        with obs.trace.capture(logdir, label="preheat-256^3") as cap:
+            for _ in range(profile_steps):
+                state = step(state)
+            jax.block_until_ready(state)
+        cap.summary      # per-scope table, or None if no trace appeared
+
+    The underlying Perfetto file stays in ``logdir`` for interactive
+    inspection (``ui.perfetto.dev``); the extracted per-scope durations
+    additionally land in the run-event log, where
+    :class:`pystella_tpu.obs.ledger.PerfLedger` picks them up.
+    """
+
+    def __init__(self, logdir, scopes=KNOWN_SCOPES, label="", step=None,
+                 log=None):
+        self.logdir = str(logdir)
+        self.scopes = scopes
+        self.label = label
+        self.step = step
+        self.log = log
+        self.summary = None
+
+    def __enter__(self):
+        import jax
+        os.makedirs(self.logdir, exist_ok=True)
+        jax.profiler.start_trace(self.logdir)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            # a failed stop must not mask the body's exception (or kill
+            # a healthy run); there is simply no trace to analyze
+            return False
+        if exc_type is None:
+            self.summary = summarize_trace(
+                self.logdir, self.scopes, label=self.label,
+                step=self.step, log=self.log)
+        return False

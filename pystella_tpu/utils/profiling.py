@@ -9,6 +9,7 @@ here: :func:`timer` (blocks on device completion via
 
 from __future__ import annotations
 
+import collections
 import time
 
 import jax
@@ -73,20 +74,40 @@ class StepTimer:
 
     Call :meth:`tick` once per step; it returns a ``(ms_per_step,
     steps_per_s)`` tuple every ``report_every`` seconds and ``None``
-    otherwise. Each report also lands in the telemetry subsystem: a
-    ``kind="step_timer"`` run event and the ``ms_per_step`` /
-    ``steps_per_s`` gauges plus a ``step.ema_ms`` EMA in the default
-    metrics registry (so :func:`pystella_tpu.obs.metrics.registry`
-    aggregation reports fleet-wide step rates).
+    otherwise.
+
+    The metrics registry's ``step`` :class:`~pystella_tpu.obs.metrics.
+    Timer` is the single timing accumulator: every tick's inter-step
+    duration is observed there (count, total seconds, per-step EMA), and
+    the window report is derived from its deltas rather than kept in
+    parallel here. Each report additionally sets the ``ms_per_step`` /
+    ``steps_per_s`` gauges (the fleet-aggregatable export) and emits a
+    ``kind="step_timer"`` run event.
+
+    Per-step wall times are also retained in :attr:`samples_ms` (a
+    bounded deque, newest last) for
+    :class:`~pystella_tpu.obs.ledger.PerfLedger` distribution analysis;
+    with ``emit_steps=True`` each tick also emits a ``kind="step_time"``
+    run event — the ledger's preferred per-step record (the bench smoke
+    and ``--profile``'d example runs enable it; leave it off for
+    million-step production runs where one event per step is too chatty).
+
+    :arg report_every: seconds between window reports.
+    :arg emit_steps: emit a ``step_time`` event on every tick.
+    :arg sample_capacity: per-step samples retained in
+        :attr:`samples_ms`.
     """
 
-    def __init__(self, report_every=30.0):
+    def __init__(self, report_every=30.0, emit_steps=False,
+                 sample_capacity=4096):
         self.report_every = float(report_every)
-        # the clock starts at the FIRST tick, not at construction, so the
-        # first reported window covers steps 2..N and excludes the first
-        # step's jit compilation
+        self.emit_steps = bool(emit_steps)
+        self.samples_ms = collections.deque(maxlen=int(sample_capacity))
+        # the clock starts at the FIRST tick, not at construction, so
+        # timing covers steps 2..N and excludes the first step's jit
+        # compilation
+        self.last_tick = None
         self.last_report = None
-        self.steps_at_report = 0
         self.steps = 0
         # register the metrics NOW: SPMD hosts construct StepTimer in
         # lockstep but cross report_every at slightly different wall
@@ -94,24 +115,35 @@ class StepTimer:
         # metric set (values stay NaN until the first report)
         _metrics.gauge("ms_per_step")
         _metrics.gauge("steps_per_s")
-        _metrics.timer("step")
+        self._timer = _metrics.timer("step")
+        self._count_at_report = self._timer.count
+        self._total_at_report = self._timer.total_s
 
     def tick(self):
         self.steps += 1
         now = time.perf_counter()
-        if self.last_report is None:
+        if self.last_tick is None:
+            self.last_tick = now
             self.last_report = now
-            self.steps_at_report = self.steps
+            self._count_at_report = self._timer.count
+            self._total_at_report = self._timer.total_s
             return None
+        elapsed = now - self.last_tick
+        self.last_tick = now
+        self._timer.observe(elapsed)  # the one accumulator
+        self.samples_ms.append(elapsed * 1e3)
+        if self.emit_steps:
+            _events.emit("step_time", step=self.steps, ms=elapsed * 1e3)
         if now - self.last_report < self.report_every:
             return None
-        window_steps = self.steps - self.steps_at_report
-        ms = (now - self.last_report) * 1e3 / window_steps
+        window_steps = self._timer.count - self._count_at_report
+        window_s = self._timer.total_s - self._total_at_report
         self.last_report = now
-        self.steps_at_report = self.steps
+        self._count_at_report = self._timer.count
+        self._total_at_report = self._timer.total_s
+        ms = window_s * 1e3 / window_steps
         _metrics.gauge("ms_per_step").set(ms)
         _metrics.gauge("steps_per_s").set(1e3 / ms)
-        _metrics.timer("step").observe(ms / 1e3)
         _events.emit("step_timer", step=self.steps, ms_per_step=ms,
                      steps_per_s=1e3 / ms)
         return ms, 1e3 / ms

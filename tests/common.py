@@ -45,6 +45,17 @@ jax.config.update("jax_enable_x64", True)  # reference defaults to float64
 
 import numpy as np  # noqa: E402
 
+import re as _re  # noqa: E402
+
+
+def jax_minor_version():
+    """``jax.__version__`` as an ``(int, int)`` pair, tolerating
+    suffixed releases like ``0.5.0rc1``. Shared by the test files'
+    jax-version-environmental skip guards (test_examples,
+    test_multihost) so the parse and the guards cannot drift apart."""
+    return tuple(int(_re.match(r"\d+", part).group())
+                 for part in jax.__version__.split(".")[:2])
+
 
 parser = argparse.ArgumentParser(add_help=False)
 parser.add_argument("--help", action="help")

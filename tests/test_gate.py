@@ -1,0 +1,357 @@
+"""Perf evidence pipeline tests: Perfetto-trace parsing, PerfLedger
+ingestion/derivation, the noise-aware regression gate's verdicts and
+exit codes on synthetic ledgers, and the smoke -> gate end-to-end run
+(pipeline integrity only — no performance assertion on CPU)."""
+
+import gzip
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import common  # noqa: F401  (side effect: forces the CPU platform)
+
+from pystella_tpu.obs import events, gate, ledger
+from pystella_tpu.obs import trace as obs_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MINI_TRACE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "data", "mini_perfetto_trace.json")
+
+
+# -- trace parsing ---------------------------------------------------------
+
+def test_mini_trace_scope_durations():
+    """The checked-in miniature Perfetto JSON exercises the matching
+    rules: longest scope wins (pair events don't leak into their
+    prefix), ``rk_stage0..4`` fold into ``rk_stage``, token boundaries
+    exclude look-alike names, non-complete events are ignored."""
+    evs = obs_trace.parse_trace_file(MINI_TRACE)
+    assert len(evs) == 12
+    table = obs_trace.scope_durations(evs)
+    assert table["fused_rk_stage_pair"]["count"] == 1
+    assert table["fused_rk_stage_pair"]["total_ms"] == pytest.approx(1.5)
+    # the jit(...)/fused_rk_stage/fusion.1 device row lands in
+    # fused_rk_stage, NOT in the longer pair scope
+    assert table["fused_rk_stage"]["count"] == 1
+    assert table["fused_rk_stage"]["total_ms"] == pytest.approx(0.5)
+    assert table["halo_exchange"]["count"] == 2
+    assert table["halo_exchange"]["total_ms"] == pytest.approx(0.5)
+    assert table["halo_exchange"]["mean_ms"] == pytest.approx(0.25)
+    # rk_stage0 + rk_stage4 fold; my_rk_stage_helper and rk_stagey are
+    # boundary-excluded
+    assert table["rk_stage"]["count"] == 2
+    assert table["rk_stage"]["total_ms"] == pytest.approx(0.22)
+    assert table["pallas_stencil"]["count"] == 1
+    assert "unrelated_op" not in table
+    assert all("rk_stagey" not in k and "helper" not in k for k in table)
+
+
+def test_trace_parser_reads_gzip(tmp_path):
+    gz = tmp_path / "mini.trace.json.gz"
+    with open(MINI_TRACE, "rb") as src, gzip.open(gz, "wb") as dst:
+        shutil.copyfileobj(src, dst)
+    assert obs_trace.parse_trace_file(str(gz)) \
+        == obs_trace.parse_trace_file(MINI_TRACE)
+    # find_trace_file locates it under a nested profile dir
+    nested = tmp_path / "plugins" / "profile" / "run1"
+    nested.mkdir(parents=True)
+    shutil.move(str(gz), nested / "host.trace.json.gz")
+    found = obs_trace.find_trace_file(str(tmp_path))
+    assert found and found.endswith("host.trace.json.gz")
+
+
+def test_trace_parser_tolerates_garbage(tmp_path):
+    bad = tmp_path / "x.trace.json"
+    bad.write_text("not json at all")
+    assert obs_trace.parse_trace_file(str(bad)) == []
+    assert obs_trace.parse_trace_file(str(tmp_path / "absent.json")) == []
+    assert obs_trace.find_trace_file(str(tmp_path / "nowhere")) is None
+
+
+def test_summarize_trace_missing_degrades(tmp_path):
+    """No trace file -> None plus a trace_missing event, never a
+    raise (the CPU/interpret degradation contract)."""
+    log_path = tmp_path / "ev.jsonl"
+    with events.EventLog(str(log_path)) as log:
+        assert obs_trace.summarize_trace(
+            str(tmp_path / "empty_logdir"), log=log) is None
+    kinds = [r["kind"] for r in events.read_events(str(log_path))]
+    assert kinds == ["trace_missing"]
+
+
+# -- ledger ----------------------------------------------------------------
+
+def test_step_stats_and_mad():
+    st = ledger.step_stats([10.0, 12.0, 11.0, 10.0, 50.0])
+    assert st["count"] == 5
+    assert st["p50_ms"] == 11.0
+    assert st["max_ms"] == 50.0
+    assert st["mad_ms"] == 1.0  # robust: the 50 ms outlier barely moves it
+    assert ledger.step_stats([])["count"] == 0
+    assert ledger.percentile([1.0, 2.0, 3.0, 4.0], 50) == 2.5
+
+
+def test_ledger_from_events(tmp_path):
+    """End-to-end ingestion: run metadata, per-step samples, a compile
+    record, and a trace summary all land in the report."""
+    path = str(tmp_path / "run.jsonl")
+    with events.EventLog(path) as log:
+        log.emit("bench_run", grid_shape=[16, 16, 16], nsteps=4)
+        log.emit("compile", label="smoke_step", compile_seconds=1.0,
+                 argument_bytes=1000, output_bytes=600, temp_bytes=50)
+        log.emit("compile", label="helper", compile_seconds=0.1,
+                 argument_bytes=10, output_bytes=5)
+        for i, ms in enumerate([2.0, 2.2, 2.1, 2.3]):
+            log.emit("step_time", step=i, ms=ms)
+        log.emit("trace_summary", trace_file="/t.json.gz",
+                 scopes={"bench_step": {"count": 4, "total_ms": 8.0,
+                                        "mean_ms": 2.0}})
+    led = ledger.PerfLedger.from_events(path, label="unit",
+                                        step_label="smoke_step")
+    assert led.sites == 16**3
+    assert led.samples_ms == [2.0, 2.2, 2.1, 2.3]
+    assert led.bytes_per_step == 1600  # the labeled record, not helper
+    rep = led.report()
+    assert rep["schema"] == ledger.REPORT_SCHEMA_VERSION
+    assert rep["steps"]["count"] == 4
+    assert rep["steps"]["p50_ms"] == pytest.approx(2.15)
+    assert rep["throughput"]["site_updates_per_s"] == pytest.approx(
+        16**3 * 1e3 / 2.15)
+    assert rep["scopes"]["bench_step"]["count"] == 4
+    assert rep["roofline"]["achieved_gbps"] == pytest.approx(
+        1600 / (2.15e-3) / 1e9)
+    # jax is imported in this process, so the fingerprint is complete
+    assert rep["env"]["jax"] and rep["env"]["platform"] == "cpu"
+    # markdown renders without blowing up on real content
+    md = ledger.render_markdown(rep)
+    assert "bench_step" in md and "Roofline" in md
+
+
+def test_ledger_scopes_to_latest_run(tmp_path):
+    """EventLog appends; a reused log holds several runs. The ledger
+    must describe only the LATEST run — mixing two runs' step times
+    would average a regression away."""
+    path = str(tmp_path / "run.jsonl")
+    with events.EventLog(path) as log:
+        log.emit("run_start", grid_shape=[8, 8, 8])
+        for ms in (100.0, 101.0):      # stale run: 10x slower
+            log.emit("step_time", ms=ms)
+        log.emit("run_start", grid_shape=[16, 16, 16])
+        for ms in (10.0, 10.5, 9.5):
+            log.emit("step_time", ms=ms)
+    led = ledger.PerfLedger.from_events(path)
+    assert led.samples_ms == [10.0, 10.5, 9.5]
+    assert led.sites == 16**3
+
+
+def test_ledger_step_timer_fallback(tmp_path):
+    """A run that only kept step_timer window reports still yields a
+    (coarser) distribution."""
+    path = str(tmp_path / "run.jsonl")
+    with events.EventLog(path) as log:
+        log.emit("step_timer", step=100, ms_per_step=3.0, steps_per_s=333.0)
+        log.emit("step_timer", step=200, ms_per_step=3.2, steps_per_s=312.0)
+    led = ledger.PerfLedger.from_events(path)
+    assert led.samples_ms == [3.0, 3.2]
+
+
+def test_ledger_write_files(tmp_path):
+    led = ledger.PerfLedger(label="unit", sites=1000)
+    for ms in (1.0, 1.1, 0.9):
+        led.add_step_ms(ms)
+    json_path = led.write(str(tmp_path / "out"))
+    assert os.path.exists(json_path)
+    assert os.path.exists(json_path.replace(".json", ".md"))
+    rep = json.load(open(json_path))
+    assert rep["steps"]["count"] == 3
+
+
+# -- gate: synthetic ledgers ----------------------------------------------
+
+def _report(samples_ms, **env_overrides):
+    led = ledger.PerfLedger(label="synthetic", sites=32**3)
+    led.samples_ms = list(samples_ms)
+    rep = led.report()
+    rep["env"].update(env_overrides)
+    return rep
+
+
+def _steady(n=60, base=10.0, jitter=0.05, seed=0):
+    rng = np.random.default_rng(seed)
+    return (base + jitter * rng.standard_normal(n)).tolist()
+
+
+def test_gate_pass_on_self_comparison():
+    rep = _report(_steady())
+    verdict = gate.compare_reports(rep, rep)
+    assert verdict["ok"] and verdict["exit_code"] == 0
+
+
+def test_gate_flags_20pct_regression():
+    """The acceptance case: a clean 20% step-time regression exits
+    nonzero; statistically-insignificant jitter does not."""
+    base = _steady(seed=1)
+    verdict = gate.compare_reports(
+        _report(base), _report([x * 1.2 for x in base]))
+    assert not verdict["ok"] and verdict["exit_code"] == 1
+    assert any("regression" in r for r in verdict["reasons"])
+    assert verdict["comparison"]["delta_pct"] == pytest.approx(20.0,
+                                                               abs=1.0)
+    # same magnitude of change, hidden inside the noise: no flag
+    noisy = _steady(n=12, jitter=2.0, seed=2)
+    verdict = gate.compare_reports(
+        _report(noisy), _report([x + 0.05 for x in noisy]))
+    assert verdict["ok"]
+
+
+def test_gate_flags_contamination_burst():
+    """The round-5 scenario, automated: a concurrent probe slows a
+    stretch of steps mid-run on the TPU -> invalid evidence (exit 2),
+    NOT a pass or a mere regression. (The detector auto-arms for
+    accelerator reports; platform-tagged synthetics exercise that
+    default path.)"""
+    tpu = {"platform": "tpu", "device_kind": "TPU v5 lite"}
+    samples = _steady(n=50, seed=3)
+    for i in range(20, 27):
+        samples[i] *= 5.0
+    verdict = gate.compare_reports(_report(_steady(seed=4), **tpu),
+                                   _report(samples, **tpu))
+    assert not verdict["ok"] and verdict["exit_code"] == 2
+    assert any(r.startswith("invalid_evidence") for r in verdict["reasons"])
+    assert verdict["contamination"]["max_burst"] >= 4
+    # the identical CPU-platform report is NOT auto-checked: shared-host
+    # scheduler stalls are legitimate there and the median comparison
+    # absorbs them (force with check_contamination="always")
+    cpu_verdict = gate.compare_reports(_report(_steady(seed=4)),
+                                       _report(samples))
+    assert cpu_verdict["exit_code"] != 2
+    forced = gate.compare_reports(_report(_steady(seed=4)),
+                                  _report(samples),
+                                  check_contamination="always")
+    assert forced["exit_code"] == 2
+
+
+def test_gate_detect_bimodal():
+    det = gate.detect_contamination([10.0] * 30 + [14.0] * 15)
+    assert det["contaminated"]
+    assert any("bimodal" in r for r in det["reasons"])
+    # a clean distribution is not contaminated
+    assert not gate.detect_contamination(_steady())["contaminated"]
+    # too few samples: detection is a no-op, not a false positive
+    assert not gate.detect_contamination([1.0, 50.0])["contaminated"]
+
+
+def test_gate_empty_report_is_invalid():
+    verdict = gate.compare_reports(_report(_steady()), _report([]))
+    assert verdict["exit_code"] == 2
+    assert any("no step samples" in r for r in verdict["reasons"])
+
+
+def test_gate_env_mismatch_is_invalid():
+    """A CPU-fallback number must never gate a TPU claim (the round-5
+    headline failure mode)."""
+    base = _report(_steady(), platform="tpu", device_kind="TPU v5 lite")
+    cur = _report(_steady(seed=5), platform="cpu", device_kind="cpu")
+    verdict = gate.compare_reports(base, cur)
+    assert verdict["exit_code"] == 2
+    assert any("different hardware" in r for r in verdict["reasons"])
+    verdict = gate.compare_reports(base, cur, allow_env_mismatch=True)
+    assert verdict["exit_code"] == 0
+    assert any("env mismatch" in w for w in verdict["warnings"])
+
+
+def test_gate_cli_exit_codes(tmp_path):
+    """main() drives argparse -> comparison -> exit code, including the
+    missing-baseline paths."""
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_report(_steady())))
+    reg = tmp_path / "reg.json"
+    reg.write_text(json.dumps(_report([x * 1.3 for x in _steady()])))
+    assert gate.main(["--baseline", str(good),
+                      "--current", str(good)]) == 0
+    assert gate.main(["--baseline", str(good),
+                      "--current", str(reg)]) == 1
+    missing = str(tmp_path / "absent.json")
+    assert gate.main(["--baseline", missing,
+                      "--current", str(good)]) == 3
+    assert gate.main(["--baseline", missing, "--current", str(good),
+                      "--allow-missing-baseline"]) == 0
+    assert gate.main(["--baseline", str(good),
+                      "--current", missing]) == 4
+    # a custom threshold turns the same delta into a pass
+    assert gate.main(["--baseline", str(good), "--current", str(reg),
+                      "--threshold-pct", "50"]) == 0
+
+
+# -- smoke -> gate end to end ---------------------------------------------
+
+def test_smoke_to_gate_end_to_end(tmp_path):
+    """Tier-1 pipeline integrity: ``bench.py --smoke`` writes a real
+    perf_report.json (per-scope breakdown, throughput, environment
+    fingerprint), and ``python -m pystella_tpu.obs.gate`` consumes it —
+    0 on self-comparison, nonzero on a synthetic degradation, nonzero
+    with invalid_evidence on a synthetic contamination burst. No
+    performance assertion: CPU numbers only gate against themselves."""
+    out = str(tmp_path / "bench_results")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--grid", "16", "--steps", "12", "--out", out],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert res.returncode == 0, res.stderr[-2000:]
+
+    report_path = os.path.join(out, "perf_report.json")
+    rep = json.load(open(report_path))
+    assert rep["steps"]["count"] == 12
+    assert rep["throughput"]["site_updates_per_s"] > 0
+    assert rep["env"]["platform"] == "cpu" and rep["env"]["jax"]
+    # the profiler capture parsed into a real per-scope breakdown
+    assert rep["scopes"].get("bench_step", {}).get("count") == 12
+    assert os.path.exists(os.path.join(out, "perf_report.md"))
+    # the event log behind it holds the full pipeline record
+    kinds = {r["kind"] for r in events.read_events(
+        os.path.join(out, "smoke_events.jsonl"))}
+    assert {"bench_run", "compile", "step_time", "trace_summary",
+            "perf_report"} <= kinds
+
+    def run_gate(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "pystella_tpu.obs.gate", *args],
+            capture_output=True, text=True, timeout=120, env=env)
+
+    # self-comparison passes
+    res = run_gate("--baseline", report_path, "--current", report_path)
+    assert res.returncode == 0, res.stderr[-2000:]
+
+    # synthetic degradation (2x, far beyond CPU jitter) fails the gate
+    slow = dict(rep)
+    slow["samples_ms"] = [x * 2.0 for x in rep["samples_ms"]]
+    slow["steps"] = ledger.step_stats(slow["samples_ms"])
+    slow_path = str(tmp_path / "slow.json")
+    json.dump(slow, open(slow_path, "w"))
+    res = run_gate("--baseline", report_path, "--current", slow_path)
+    assert res.returncode == 1, (res.stdout, res.stderr[-2000:])
+
+    # synthetic contamination burst -> invalid evidence (the detector
+    # is forced on: auto-mode skips it for CPU reports, where scheduler
+    # stalls are legitimate)
+    cont = dict(rep)
+    samples = rep["samples_ms"] * 3
+    for i in range(12, 18):
+        samples[i] *= 5.0
+    cont["samples_ms"] = samples
+    cont["steps"] = ledger.step_stats(samples)
+    cont_path = str(tmp_path / "cont.json")
+    json.dump(cont, open(cont_path, "w"))
+    res = run_gate("--baseline", report_path, "--current", cont_path,
+                   "--check-contamination", "always")
+    assert res.returncode == 2, (res.stdout, res.stderr[-2000:])
+    assert "invalid_evidence" in res.stdout

@@ -17,6 +17,8 @@ import sys
 
 import pytest
 
+import common
+
 WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
 
@@ -26,6 +28,13 @@ def _free_port():
         return s.getsockname()[1]
 
 
+@pytest.mark.skipif(
+    common.jax_minor_version() < (0, 5),
+    reason="jax-0.4.x environmental: cross-process collectives on the "
+           "CPU backend raise \"Multiprocess computations aren't "
+           "implemented on the CPU backend\" (workers build a localhost "
+           "jax.distributed cluster over virtual CPU devices, which "
+           "0.4.x cannot execute); re-arms on jax >= 0.5")
 @pytest.mark.parametrize("nproc", [2, 3])
 def test_process_cluster(tmp_path, nproc):
     """2- and 3-process clusters (each contributing 2 devices) — the

@@ -117,6 +117,17 @@ def test_reduce_snapshots_multihost_semantics():
     assert out["ms_per_step"] == 20.0     # gauges reduce as declared
     assert out["peak_hbm"] == 5.0
 
+    # a host that has registered but not yet set a gauge (NaN — e.g.
+    # it hasn't crossed its StepTimer report cadence) must not poison
+    # the fleet-wide reduction
+    hosts[1]["ms_per_step"] = float("nan")
+    out = reg.reduce_snapshots(hosts)
+    assert out["ms_per_step"] == 20.0     # mean of the two reporters
+    # all-NaN stays NaN rather than disappearing
+    for h in hosts:
+        h["peak_hbm"] = float("nan")
+    assert np.isnan(reg.reduce_snapshots(hosts)["peak_hbm"])
+
 
 def test_aggregate_on_virtual_mesh(decomp):
     """aggregate() runs the real gather path (all_gather_hosts) with the
@@ -250,6 +261,27 @@ def test_step_timer_feeds_metrics_and_events(event_log):
     assert len(evs) == 1
     assert evs[0]["data"]["ms_per_step"] == ms
     assert metrics.gauge("ms_per_step").value == ms
+
+
+def test_step_timer_registry_is_the_accumulator(event_log):
+    """Satellite: the registry's ``step`` Timer is the one timing store
+    — every tick observes the per-step duration there, the window
+    report derives from its deltas, and per-step samples are retained
+    for the PerfLedger (``step_time`` events with ``emit_steps``)."""
+    t = metrics.timer("step")
+    count0, total0 = t.count, t.total_s
+    st = ps.StepTimer(report_every=1e9, emit_steps=True)
+    st.tick()  # arm
+    for _ in range(3):
+        st.tick()
+    assert t.count == count0 + 3  # one observation PER STEP, not window
+    assert t.total_s > total0
+    assert len(st.samples_ms) == 3
+    evs = events.read_events(event_log, kind="step_time")
+    assert [e["data"]["ms"] for e in evs] == \
+        pytest.approx(list(st.samples_ms))
+    # report_every not reached: no window report, no window event
+    assert events.read_events(event_log, kind="step_timer") == []
 
 
 def test_fused_step_counter(make_decomp):
