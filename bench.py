@@ -769,6 +769,18 @@ def run_smoke(argv=None):
 
     import contextlib
 
+    # the overlapped-halo payload below needs a sharded mesh; fake 8
+    # host-platform devices before jax initializes (harmless for the
+    # main payload, which pins a single-device mesh, and for non-CPU
+    # backends, which ignore the host-platform count). Guard on the
+    # flag NAME: an explicit user-set count must not get a second,
+    # conflicting instance appended
+    flags = os.environ.get("XLA_FLAGS", "")
+    if ("jax" not in sys.modules
+            and "xla_force_host_platform_device_count" not in flags):
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
     import jax
     import pystella_tpu as ps
     from pystella_tpu import obs
@@ -799,6 +811,26 @@ def run_smoke(argv=None):
         state = compiled(state, t, dt, rhs_args)
     sync(state)
 
+    # overlapped-halo payload: a sharded-mesh Laplacian through the
+    # interior/shell split (PYSTELLA_HALO_OVERLAP / FiniteDifferencer
+    # overlap=True), so the smoke report exercises the halo_overlap
+    # scope names and the ledger's exposed-vs-hidden communication line
+    # end to end. Built (and compiled) before the capture; runs inside
+    # it so its spans land in the trace_summary. Degrades to a note
+    # when the backend exposes fewer than 4 devices.
+    overlap_seg = None
+    if len(jax.devices()) >= 4:
+        odec = ps.DomainDecomposition((2, 2, 1),
+                                      devices=jax.devices()[:4])
+        ofd = ps.FiniteDifferencer(odec, 2, 0.1, mode="halo",
+                                   overlap=True)
+        ox = odec.shard(np.random.default_rng(13).standard_normal(
+            grid_shape).astype(np.float32))
+        jax.block_until_ready(ofd.lap(ox))  # compile outside the window
+        overlap_seg = (odec, ofd, ox)
+    else:
+        hb("smoke: <4 devices — skipping the overlapped-halo payload")
+
     steptimer = ps.StepTimer(report_every=float("inf"), emit_steps=True)
     capture = (contextlib.nullcontext() if args.no_profile else
                obs.trace.capture(os.path.join(args.out, "smoke_trace"),
@@ -810,6 +842,19 @@ def run_smoke(argv=None):
                 state = compiled(state, t, dt, rhs_args)
                 sync(state)
             steptimer.tick()
+        if overlap_seg is not None:
+            odec, ofd, ox = overlap_seg
+            for _ in range(6):
+                with obs.trace_scope("halo_overlap"):
+                    sync(ofd.lap(ox))
+
+    if overlap_seg is not None:
+        # per-device ICI bytes one overlapped call moves — computed by
+        # the decomposition from slab shapes/dtype at trace time; the
+        # ledger derives the achieved-ICI-bandwidth line from it
+        obs.emit("halo_traffic",
+                 bytes_per_step=overlap_seg[0].traced_halo_bytes(),
+                 label="smoke-overlap")
 
     ledger = obs.PerfLedger.from_events(
         events_path, registry=obs.registry(), label=f"smoke-{n}^3",
@@ -852,6 +897,15 @@ def payload(platform_wanted):
     if platform_wanted == "cpu":
         from __graft_entry__ import _drop_remote_tpu_plugin
         _drop_remote_tpu_plugin()
+    elif platform_wanted == "tpu":
+        # async-collective + latency-hiding-scheduler flags must be in
+        # LIBTPU_INIT_ARGS before the backend dials; they are what lets
+        # the overlapped halo path actually hide ppermutes behind the
+        # interior compute. Recorded in every perf report's environment
+        # fingerprint (obs.ledger.xla_flag_fingerprint), so a baseline
+        # measured without them is flagged by the gate.
+        from pystella_tpu.parallel.overlap import ensure_scheduler_flags
+        ensure_scheduler_flags()
     import jax
 
     hb(f"payload({platform_wanted}): dialing device "

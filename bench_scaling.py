@@ -37,6 +37,13 @@ if _cpu:
             _flags + " --xla_force_host_platform_device_count=8"
     from __graft_entry__ import _drop_remote_tpu_plugin
     _drop_remote_tpu_plugin()
+else:
+    # async-collective + latency-hiding-scheduler flags, set before the
+    # backend dials: the sharded payloads' overlapped halo path depends
+    # on them to hide ppermutes behind interior compute (recorded in
+    # every perf report's env fingerprint)
+    from pystella_tpu.parallel.overlap import ensure_scheduler_flags
+    ensure_scheduler_flags()
 
 import numpy as np  # noqa: E402
 import jax  # noqa: E402

@@ -84,9 +84,13 @@ class RelaxationBase:
     """
 
     def __init__(self, decomp, lhs_dict, halo_shape=1, omega=1.0,
-                 dtype=None, smoother="auto", **kwargs):
+                 dtype=None, smoother="auto", overlap=None, **kwargs):
         self.decomp = decomp
         self.halo_shape = int(halo_shape)
+        # halo-overlap policy for sharded levels (resolved per level
+        # decomp at compile time — coarse replicated levels never
+        # communicate); None defers to PYSTELLA_HALO_OVERLAP / auto
+        self._overlap_override = overlap
         self.omega = float(kwargs.pop("fixed_parameters", {}).get(
             "omega", omega))
         self.dtype = dtype
@@ -129,10 +133,9 @@ class RelaxationBase:
 
     # -- local stencil + environment ---------------------------------------
 
-    def _local_lap(self, x, dx, pad_fn):
+    def _lap_from_padded(self, padded, dx):
         h = self.halo_shape
-        la = x.ndim - 3
-        padded = pad_fn(x, (h,) * 3)
+        la = padded.ndim - 3
         acc = None
         for d in range(3):
             y = padded
@@ -143,6 +146,19 @@ class RelaxationBase:
                                    1 / dx[d] ** 2)
             acc = term if acc is None else acc + term
         return acc
+
+    def _local_lap(self, x, dx, pad_fn):
+        h = self.halo_shape
+        return self._lap_from_padded(pad_fn(x, (h,) * 3), dx)
+
+    def _center(self, padded):
+        """The unpadded block back out of a halo-padded one."""
+        h = self.halo_shape
+        la = padded.ndim - 3
+        y = padded
+        for d in range(3):
+            y = _shifted(y, la + d, 0, h)
+        return y
 
     def _lap_diag(self, dx):
         return float(sum(self.stencil.coefs[0] / d ** 2 for d in dx))
@@ -157,9 +173,58 @@ class RelaxationBase:
 
     # -- compiled per-level operations --------------------------------------
 
+    def _overlap_body(self, kind, level, decomp, nu=None):
+        """The overlapped-halo variant of a sharded level's XLA body:
+        per sweep, the unknowns' ``ppermute``s are issued first, the
+        interior update is computed from local data while the
+        collectives fly, and the boundary shells are stitched once
+        halos land (``decomp.overlap_stencil``; bit-exact with the
+        padded body — identical taps and per-element arithmetic)."""
+        names = list(self.f_to_rho_dict)
+        h = self.halo_shape
+        halo = (h,) * 3
+        dx = level.dx
+        exprs = {"smooth": self.step_exprs, "residual": self.resid_exprs,
+                 "tau": self.lhs_exprs}[kind]
+
+        def apply(padded_fs, ex):
+            env = {**ex.get("aux", {}), **ex.get("rhos", {})}
+            env["omega"] = self.omega
+            env["_lap_diag"] = self._lap_diag(dx)
+            for n in names:
+                p = padded_fs[n]
+                env[n] = self._center(p)
+                env["lap_" + n] = self._lap_from_padded(p, dx)
+            if kind == "tau":
+                return {self.f_to_rho_dict[n]:
+                        ex["rr"][n] + evaluate(exprs[n], env)
+                        for n in names}
+            return {n: evaluate(exprs[n], env) for n in names}
+
+        if kind == "smooth":
+            def body(fs, rhos, aux):
+                def it(_, fs):
+                    return decomp.overlap_stencil(
+                        fs, halo, apply,
+                        extras={"rhos": rhos, "aux": aux})
+                return lax.fori_loop(0, nu, it, fs)
+        elif kind == "residual":
+            def body(fs, rhos, aux):
+                return decomp.overlap_stencil(
+                    fs, halo, apply, extras={"rhos": rhos, "aux": aux})
+        else:
+            def body(fs, rr, aux):
+                return decomp.overlap_stencil(
+                    fs, halo, apply, extras={"rr": rr, "aux": aux})
+        return body
+
     def _get_compiled(self, kind, level, nu=None, decomp=None):
+        from pystella_tpu.parallel import overlap as _overlap
         decomp = decomp if decomp is not None else self.decomp
-        key = (kind, level, nu, decomp)
+        use_overlap = (level.sharded
+                       and _overlap.enabled(decomp,
+                                            self._overlap_override))
+        key = (kind, level, nu, decomp, use_overlap)
         cached = self._compiled.get(key)
         if cached is not None:
             return cached
@@ -168,7 +233,9 @@ class RelaxationBase:
                   else periodic_pad)
         dx = level.dx
 
-        if kind == "smooth":
+        if use_overlap and kind in ("smooth", "residual", "tau"):
+            body = self._overlap_body(kind, level, decomp, nu)
+        elif kind == "smooth":
             def body(fs, rhos, aux):
                 def it(_, fs):
                     env = self._env(fs, rhos, aux, dx, pad_fn)
@@ -299,6 +366,19 @@ class RelaxationBase:
 
         halo = sharded_halo(self.halo_shape, px, py)
         sharded = px > 1 or py > 1
+        ov = None
+        if sharded and px > 1 and py == 1:
+            from pystella_tpu.ops.pallas_stencil import (
+                OverlapStreamingStencil)
+            from pystella_tpu.parallel import overlap as _overlap
+            if _overlap.enabled(decomp, self._overlap_override):
+                # x-sharded sweeps overlap the slab ppermutes with the
+                # interior kernel (bit-exact; infeasible shapes keep
+                # the padded single launch)
+                try:
+                    ov = OverlapStreamingStencil(st, self.halo_shape)
+                except ValueError:
+                    ov = None
 
         def run(fstack, rhostack, aux_args, nu):
             scalars = dict(zip(aux_scal, aux_args[len(aux_lat):]))
@@ -306,6 +386,9 @@ class RelaxationBase:
                       **dict(zip(aux_lat, aux_args[:len(aux_lat)]))}
 
             def one(fst):
+                if ov is not None:
+                    return ov(fst, decomp, scalars=scalars,
+                              extras=extras)["out"]
                 fin = (decomp.pad_with_halos(
                     fst, halo, exchange=(self.halo_shape,) * 3)
                     if sharded else fst)

@@ -234,6 +234,23 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                 + m for m in env_mismatch]
             return verdict
 
+    # same silicon but different XLA scheduler/async-collective flags
+    # (or halo-overlap policy): the comparison still runs — the flags
+    # change scheduling, not what is measured — but the verdict carries
+    # a warning, because a latency-hiding-scheduler baseline is not a
+    # like-for-like baseline for a run without it
+    bflags = (baseline.get("env") or {}).get("xla_flags")
+    cflags = (current.get("env") or {}).get("xla_flags")
+    if bflags is not None and cflags is not None and bflags != cflags:
+        diffs = sorted(k for k in set(bflags) | set(cflags)
+                       if bflags.get(k) != cflags.get(k))
+        verdict["warnings"].append(
+            "XLA scheduler/overlap flags differ between baseline and "
+            "current (comparison kept, but treat deltas with care): "
+            + ", ".join(
+                f"{k}: {bflags.get(k)!r} vs {cflags.get(k)!r}"
+                for k in diffs))
+
     base_steps = baseline.get("steps") or {}
     base_p50 = base_steps.get("p50_ms")
     cur_p50 = cur_steps.get("p50_ms")

@@ -79,6 +79,35 @@ def _version_of(dist):
         return None
 
 
+#: env-var name substrings that make an XLA/libtpu flag relevant to the
+#: fingerprint: async-collective and latency-hiding-scheduler toggles
+#: change what a step-time comparison means (the overlapped halo path
+#: depends on them to pay off). Kept in sync with
+#: ``pystella_tpu.parallel.overlap`` — duplicated here because this
+#: module must stay loadable BY FILE in a jax-free supervisor, where
+#: the package import (and thus jax) is unavailable.
+_FLAG_MARKERS = ("async_collective", "async_all_gather",
+                 "latency_hiding", "scheduler")
+
+
+def xla_flag_fingerprint():
+    """The scheduler-relevant flags in this process's environment
+    (``XLA_FLAGS`` + ``LIBTPU_INIT_ARGS``), as ``{name: value}``, plus
+    the ``PYSTELLA_HALO_OVERLAP`` policy setting when present —
+    stdlib-only, embedded in every report's environment fingerprint so
+    the gate can warn when two reports differ only in flags."""
+    flags = {}
+    for var in ("XLA_FLAGS", "LIBTPU_INIT_ARGS"):
+        for tok in os.environ.get(var, "").split():
+            name, _, value = tok.lstrip("-").partition("=")
+            if any(m in name for m in _FLAG_MARKERS):
+                flags[name] = value if value else "true"
+    setting = os.environ.get("PYSTELLA_HALO_OVERLAP")
+    if setting is not None:
+        flags["PYSTELLA_HALO_OVERLAP"] = setting
+    return flags
+
+
 def environment_fingerprint():
     """Everything needed to decide whether two perf reports are
     comparable. Resolved from an already-imported jax only (the module
@@ -93,6 +122,7 @@ def environment_fingerprint():
         "device_kind": None,
         "num_devices": None,
         "num_processes": None,
+        "xla_flags": xla_flag_fingerprint(),
     }
     jax = sys.modules.get("jax")
     if jax is not None:
@@ -175,6 +205,7 @@ class PerfLedger:
         self.scopes = {}                # trace-derived per-scope table
         self.trace_file = None
         self.bytes_per_step = None      # HBM traffic lower bound
+        self.halo_bytes_per_step = None  # ICI bytes per overlapped call
         self.compile_records = []       # compile-event payloads
         self.metrics = {}               # registry snapshot
         self.meta = {}                  # run-metadata event payload
@@ -225,6 +256,11 @@ class PerfLedger:
             elif kind == "trace_summary":
                 led.scopes = data.get("scopes") or {}
                 led.trace_file = data.get("trace_file")
+            elif kind == "halo_traffic" and isinstance(
+                    data.get("bytes_per_step"), (int, float)):
+                # per-device ICI bytes one overlapped halo update moves
+                # (drivers compute it from decomp.traced_halo_bytes())
+                led.halo_bytes_per_step = float(data["bytes_per_step"])
             elif kind == "compile":
                 led.compile_records.append(data)
             elif kind in ("run_start", "bench_run"):
@@ -290,6 +326,65 @@ class PerfLedger:
                 "peak_gbps": peak,
                 "fraction_of_peak": frac}
 
+    def overlap_summary(self):
+        """Exposed-vs-hidden communication time of the overlapped halo
+        path, from the trace scope table: the comm denominator is the
+        raw ``collective-permute`` op rows (present in device traces
+        with no named-scope path; falls back to the ``halo_exchange``
+        scope), the hidden share is bounded by the
+        ``halo_overlap_interior`` compute that ran concurrently, and
+        ``halo_overlap`` host spans count the overlapped calls in the
+        window. With a ``halo_traffic`` event (per-device ICI bytes per
+        overlapped call) an achieved-ICI-bandwidth estimate is derived.
+        ``None`` when the trace shows no halo activity at all.
+
+        Device rows appear once PER DEVICE in a trace, so the raw scope
+        totals are fleet sums; ``comm_ms``/``interior_ms`` here are
+        normalized to per-device wall time (divided by
+        ``env.num_devices``), which is what the exposed-vs-hidden split
+        and the per-device ICI bandwidth are about. ``halo_overlap``
+        host spans are emitted once per call and are not scaled."""
+        scopes = self.scopes or {}
+        comm_scope = next((s for s in ("collective-permute",
+                                       "halo_exchange") if s in scopes),
+                          None)
+        calls = scopes.get("halo_overlap")
+        if comm_scope is None and calls is None:
+            return None
+        ndev = self.env.get("num_devices") or 1
+        comm = scopes.get(comm_scope) or {}
+        comm_ms = comm.get("total_ms")
+        if isinstance(comm_ms, (int, float)):
+            comm_ms /= ndev
+        interior = scopes.get("halo_overlap_interior")
+        interior_ms = interior.get("total_ms") if interior else None
+        if isinstance(interior_ms, (int, float)):
+            interior_ms /= ndev
+        hidden = exposed = None
+        if isinstance(comm_ms, (int, float)):
+            # the interior compute is the only work the scheduler can
+            # hide the collectives behind; without device rows for it
+            # (host-span-only CPU traces) nothing is provably hidden
+            hidden = min(comm_ms, interior_ms or 0.0)
+            exposed = comm_ms - hidden
+        n_calls = calls.get("count") if calls else None
+        ici = None
+        if (self.halo_bytes_per_step and n_calls
+                and isinstance(comm_ms, (int, float)) and comm_ms > 0):
+            ici = (self.halo_bytes_per_step * n_calls
+                   / (comm_ms / 1e3) / 1e9)
+        return {
+            "comm_scope": comm_scope,
+            "comm_ms": comm_ms,
+            "interior_ms": interior_ms,
+            "hidden_ms": hidden,
+            "exposed_ms": exposed,
+            "num_devices": ndev,
+            "overlapped_calls": n_calls,
+            "halo_bytes_per_step": self.halo_bytes_per_step,
+            "achieved_ici_gbps": ici,
+        }
+
     # -- report ------------------------------------------------------------
 
     def report(self):
@@ -309,6 +404,7 @@ class PerfLedger:
                 "site_updates_per_s": self.site_updates_per_s(),
             },
             "roofline": self.roofline(),
+            "overlap": self.overlap_summary(),
             "scopes": self.scopes,
             "trace_file": self.trace_file,
             "metrics": self.metrics,
@@ -380,6 +476,29 @@ def render_markdown(rep):
         f"{_fmt(rf.get('peak_gbps'))} GB/s peak -> "
         f"{_fmt(rf.get('fraction_of_peak'), '.1%')} of roofline",
         "",
+    ]
+    ov = rep.get("overlap")
+    if ov:
+        lines += ["## Communication overlap", ""]
+        lines.append(
+            f"- halo comm (`{ov.get('comm_scope')}` rows, per-device): "
+            f"{_fmt(ov.get('comm_ms'))} ms in the traced window — "
+            f"hidden behind interior compute {_fmt(ov.get('hidden_ms'))}"
+            f" ms, exposed {_fmt(ov.get('exposed_ms'))} ms")
+        if ov.get("interior_ms") is None:
+            lines.append(
+                "- *(no `halo_overlap_interior` device rows in this "
+                "trace — host-span-only captures cannot attribute "
+                "hiding, so all comm time counts as exposed)*")
+        if ov.get("halo_bytes_per_step"):
+            lines.append(
+                f"- halo traffic {_fmt(ov['halo_bytes_per_step'], ',.0f')}"
+                f" B/call x {_fmt(ov.get('overlapped_calls'), '.0f')} "
+                f"overlapped call(s) -> achieved "
+                f"~{_fmt(ov.get('achieved_ici_gbps'))} GB/s ICI "
+                "(per-device estimate)")
+        lines.append("")
+    lines += [
         "## Per-scope breakdown",
         "",
     ]

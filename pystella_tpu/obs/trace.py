@@ -46,11 +46,19 @@ __all__ = ["KNOWN_SCOPES", "capture", "find_trace_file",
 
 #: the PR-1 instrumentation vocabulary (doc/observability.md "Trace
 #: scopes") plus the driver-level spans the bench/smoke loops add.
+#: ``halo_overlap*`` are the overlapped-halo-path phases (whole
+#: overlapped update / interior-while-collectives-fly / shell
+#: stitching); ``collective-permute`` matches the RAW XLA ppermute op
+#: rows, which appear in device traces (TPU and the TFRT CPU backend)
+#: without any named-scope path — the comm-time denominator for the
+#: ledger's exposed-vs-hidden breakdown.
 KNOWN_SCOPES = (
     "rk_stage",
     "fused_rk_stage", "fused_rk_stage_pair", "fused_rk_stage_energy",
     "fused_coupled_pair",
     "halo_exchange",
+    "halo_overlap", "halo_overlap_interior", "halo_overlap_shells",
+    "collective-permute",
     "pallas_stencil", "pallas_resident_stencil",
     "mg_cycle", "mg_smooth", "mg_residual",
     "bench_step", "driver_step",

@@ -166,13 +166,22 @@ class FiniteDifferencer:
         ppermute halos, XLA stencils) or ``"roll"`` (global jnp.roll; XLA
         infers collectives). ``"auto"`` picks pallas when the lattice y/z
         axes are unsharded, else halo.
+    :arg overlap: overlap the halo exchange with interior compute on
+        sharded meshes (interior/shell split — bit-exact with the padded
+        path; see :mod:`pystella_tpu.parallel.overlap`). ``None``
+        resolves ``PYSTELLA_HALO_OVERLAP`` / auto (on when the mesh is
+        sharded). Applies to the halo-mode XLA stencils (any sharded
+        axes) and to x-sharded pallas-mode kernels; infeasible
+        configurations fall back to the padded path.
     """
 
     def __init__(self, decomp, halo_shape, dx, *, rank_shape=None,
                  first_stencil_factory=FirstCenteredDifference,
                  stencil_factory=SecondCenteredDifference,
-                 mode="auto", **kwargs):
+                 mode="auto", overlap=None, **kwargs):
+        from pystella_tpu.parallel import overlap as _overlap
         self.decomp = decomp
+        self.overlap = _overlap.enabled(decomp, override=overlap)
         self.h = int(halo_shape)
         if np.isscalar(dx):
             dx = (dx,) * 3
@@ -206,15 +215,23 @@ class FiniteDifferencer:
         return stencil.get_eigenvalues(k, dx)
 
     # -- local-block stencil bodies ----------------------------------------
+    #
+    # Each op is a *core* acting on a halo-padded block plus a thin
+    # wrapper that routes it through ``decomp.overlap_stencil`` — with
+    # overlap on (sharded meshes), the ppermutes are issued first, the
+    # interior inset is computed from local data while the collectives
+    # fly, and the boundary shells are stitched once halos land;
+    # otherwise the same core runs once on the padded block. Both paths
+    # are bit-exact (identical taps and per-element reduction order).
 
-    def _pad(self, x, axes):
-        """Halo-pad the lattice axes of a local block (inside shard_map)."""
+    def _stencil(self, x, axes, core, overlap=None):
         halo = tuple(self.h if d in axes else 0 for d in range(3))
-        return self.decomp.pad_with_halos(x, halo)
+        return self.decomp.overlap_stencil(
+            x, halo, core,
+            overlap=self.overlap if overlap is None else overlap)
 
-    def _local_grad(self, x):
-        la = x.ndim - 3  # first lattice axis
-        padded = self._pad(x, (0, 1, 2))
+    def _grad_core(self, padded):
+        la = padded.ndim - 3  # first lattice axis
         parts = []
         for d in range(3):
             y = padded
@@ -226,9 +243,11 @@ class FiniteDifferencer:
                                          self.h, 1, 1 / self.dx[d]))
         return jnp.stack(parts, axis=la)
 
-    def _local_lap(self, x):
-        la = x.ndim - 3
-        padded = self._pad(x, (0, 1, 2))
+    def _local_grad(self, x):
+        return self._stencil(x, (0, 1, 2), self._grad_core)
+
+    def _lap_core(self, padded):
+        la = padded.ndim - 3
         acc = None
         for d in range(3):
             y = padded
@@ -240,9 +259,11 @@ class FiniteDifferencer:
             acc = term if acc is None else acc + term
         return acc
 
-    def _local_grad_lap(self, x):
-        la = x.ndim - 3
-        padded = self._pad(x, (0, 1, 2))
+    def _local_lap(self, x):
+        return self._stencil(x, (0, 1, 2), self._lap_core)
+
+    def _grad_lap_core(self, padded):
+        la = padded.ndim - 3
         grads, lap = [], None
         for d in range(3):
             y = padded
@@ -256,19 +277,32 @@ class FiniteDifferencer:
             lap = term if lap is None else lap + term
         return jnp.stack(grads, axis=la), lap
 
-    def _local_pd(self, x, d):
-        la = x.ndim - 3
-        padded = self._pad(x, (d,))
-        return _apply_centered(padded, la + d, self.first.coefs,
-                               self.h, 1, 1 / self.dx[d])
+    def _local_grad_lap(self, x):
+        return self._stencil(x, (0, 1, 2), self._grad_lap_core)
+
+    def _local_pd(self, x, d, overlap=None):
+        def pd_core(padded, d=d):
+            la = padded.ndim - 3
+            return _apply_centered(padded, la + d, self.first.coefs,
+                                   self.h, 1, 1 / self.dx[d])
+        return self._stencil(x, (d,), pd_core, overlap=overlap)
 
     def _local_div(self, v):
         # v: (..., 3, nx, ny, nz) local block; divergence = sum_d pd_d(v[d])
+        #
+        # kept on the PADDED path even with overlap on: each component's
+        # derivative is exchanged along a different axis, so the three
+        # stitched terms carry mismatched concat boundaries — summing
+        # them lets XLA re-fuse (and re-contract FMAs) differently per
+        # intersection piece, breaking the bit-exactness contract at the
+        # 1-ulp level (measured on the CPU backend). A single split
+        # would need the whole vector padded on all three axes — 3x the
+        # ICI bytes — for an operator that is not on the hot path.
         la = v.ndim - 3
         acc = None
         for d in range(3):
             comp = lax.index_in_dim(v, d, axis=la - 1, keepdims=False)
-            term = self._local_pd(comp, d)
+            term = self._local_pd(comp, d, overlap=False)
             acc = term if acc is None else acc + term
         return acc
 
@@ -403,11 +437,24 @@ class FiniteDifferencer:
                                  out_defs, dtype=dtype)
 
         if px > 1 or py > 1:
-            from pystella_tpu.ops.pallas_stencil import sharded_halo
+            from pystella_tpu.ops.pallas_stencil import (
+                OverlapStreamingStencil, sharded_halo)
             decomp = self.decomp
             halo = sharded_halo(self.h, px, py)
+            ov = None
+            if self.overlap and py == 1:
+                # x-sharded windows admit the interior/shell launch
+                # split (y shells have no legal sublane blocking);
+                # infeasible shapes keep the padded single launch
+                try:
+                    ov = OverlapStreamingStencil(st, self.h)
+                except ValueError as err:
+                    logger.info("pallas halo overlap infeasible for %s "
+                                "(%s); padded path", global_shape, err)
 
             def sharded_fn(x):
+                if ov is not None:
+                    return tuple(ov(x, decomp).values())
                 xpad = decomp.pad_with_halos(x, halo,
                                              exchange=(self.h,) * 3)
                 return tuple(st(xpad).values())

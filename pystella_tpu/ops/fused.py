@@ -88,7 +88,8 @@ class FusedScalarStepper(_step.Stepper):
                  tableau=None, dtype=jnp.float32, bx=None, by=None,
                  dt=None, pair_stages=True, pair_bx=None, pair_by=None,
                  interpret=None, donate=False, resident=None,
-                 carry_dtype=None, assemble="concat", **kwargs):
+                 carry_dtype=None, assemble="concat", overlap=None,
+                 **kwargs):
         tableau = tableau or _step.LowStorageRK54
         self._A = tableau._A
         self._B = tableau._B
@@ -108,6 +109,13 @@ class FusedScalarStepper(_step.Stepper):
                 "the fused tier available)")
         self._px = decomp.proc_shape[0]
         self._py = decomp.proc_shape[1]
+        # overlapped halo path: issue the slab ppermutes first, run the
+        # interior kernel while they fly, stitch the x shells when the
+        # halos land (bit-exact with the padded launch; see
+        # pystella_tpu.parallel.overlap). Resolved once here: per-call
+        # kwarg > PYSTELLA_HALO_OVERLAP > auto (on for sharded meshes).
+        from pystella_tpu.parallel import overlap as _overlap
+        self._overlap = _overlap.enabled(decomp, override=overlap)
         self.grid_shape = tuple(grid_shape)
         if np.isscalar(dx):
             dx = (dx,) * 3
@@ -306,23 +314,41 @@ class FusedScalarStepper(_step.Stepper):
             return jax.jit(call, donate_argnums=(0, 2))
 
         import jax
-        from pystella_tpu.ops.pallas_stencil import sharded_halo
+        from pystella_tpu.ops.pallas_stencil import (
+            OverlapStreamingStencil, sharded_halo)
         decomp = self.decomp
         halo = sharded_halo(self.h, self._px, self._py)
         out_names = list(st.out_defs) + list(st.sum_defs)
         scalar_names = st.scalar_names
         from jax.sharding import PartitionSpec as P
 
+        ov = None
+        if self._overlap and self._px > 1 and self._py == 1:
+            # x-sharded stages take the interior/shell launch split
+            # (kernels with sum outputs keep the padded launch — the
+            # split would change the deterministic reduction order)
+            try:
+                ov = OverlapStreamingStencil(st, self.h)
+            except ValueError as e:
+                import logging
+                logging.getLogger(__name__).info(
+                    "fused halo overlap infeasible (%s); padded path", e)
+
         def body(*flat):
             nw = len(windows)
-            wins = {n: decomp.pad_with_halos(a, halo,
-                                             exchange=(self.h,) * 3)
-                    for n, a in zip(windows, flat[:nw])}
             ns = len(scalar_names)
             scalars = dict(zip(scalar_names, flat[nw:nw + ns]))
             extras = dict(zip(extra_names, flat[nw + ns:]))
-            arg = wins[windows[0]] if nw == 1 else wins
-            outs = st(arg, scalars=scalars, extras=extras)
+            if ov is not None:
+                raw = dict(zip(windows, flat[:nw]))
+                outs = ov(raw[windows[0]] if nw == 1 else raw, decomp,
+                          scalars=scalars, extras=extras)
+            else:
+                wins = {n: decomp.pad_with_halos(a, halo,
+                                                 exchange=(self.h,) * 3)
+                        for n, a in zip(windows, flat[:nw])}
+                arg = wins[windows[0]] if nw == 1 else wins
+                outs = st(arg, scalars=scalars, extras=extras)
             for n in st.sum_defs:  # per-shard partials -> global sums
                 outs[n] = decomp.psum(outs[n])
             return tuple(outs[n] for n in out_names)

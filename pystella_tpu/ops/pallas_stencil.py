@@ -39,13 +39,15 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from pystella_tpu import _compat
 from pystella_tpu.obs.scope import trace_scope
 
-__all__ = ["StreamingStencil", "ResidentStencil", "Taps", "HY", "LANE",
+__all__ = ["StreamingStencil", "ResidentStencil", "OverlapStreamingStencil",
+           "Taps", "HY", "LANE",
            "choose_blocks", "sharded_halo", "lap_from_taps",
            "grad_from_taps", "vmem_limit_bytes", "VMEM_LIMIT_BYTES"]
 
@@ -777,6 +779,20 @@ class StreamingStencil:
             compiler_params=_compiler_params(self.interpret),
         )
 
+    def with_lattice(self, lattice_shape, bx=None, by=None):
+        """A new :class:`StreamingStencil` sharing this one's body,
+        definitions, dtypes and halo mode, built for a different local
+        lattice shape — how :class:`OverlapStreamingStencil` derives the
+        interior and shell kernels from the full-block kernel. Raises
+        ``ValueError`` when the new shape admits no feasible blocking."""
+        return StreamingStencil(
+            lattice_shape, self.win_defs, self.h, self.body,
+            self.out_defs, extra_defs=self.extra_defs,
+            scalar_names=self.scalar_names, dtype=self.dtype,
+            bx=bx, by=by, x_halo=self.x_halo, y_halo=self.y_halo,
+            interpret=self.interpret, sum_defs=self.sum_defs,
+            dtypes=self.dtypes, assemble=self.assemble)
+
     # -- invocation --------------------------------------------------------
 
     def __call__(self, f, scalars=None, extras=None):
@@ -835,4 +851,110 @@ class StreamingStencil:
             # strip the (nt_pad8, LANE) tile padding
             nt = self.sum_defs[n]
             out[n] = sum(s[nlat + k][:nt, 0] for s in slabs)
+        return out
+
+
+class OverlapStreamingStencil:
+    """Interior + x-shell split of a streaming stencil kernel for
+    communication/computation overlap on x-sharded lattices.
+
+    The padded single launch makes the whole kernel wait on the
+    ``ppermute``d x halos. Here the full-block kernel is rebuilt (same
+    body, same definitions — :meth:`StreamingStencil.with_lattice`) as
+    three launches over an x partition of the local block:
+
+    - *interior*, lattice ``(X - 2h, Y, Z)``: its ``x_halo``-padded
+      input is exactly the RAW local block — no dependence on the
+      collectives, so it runs while they are in flight;
+    - two *x shells*, lattice ``(h, Y, Z)`` with ``bx = h``: their
+      inputs are ``concat(halo, first/last 2h local rows)``, computed
+      once the halos land.
+
+    Outputs stitch back with one concatenate per output. Bit-exact with
+    the padded launch: every output element sees identical tap offsets
+    and per-element arithmetic (blocking never enters the math).
+
+    Feasibility (``ValueError`` otherwise — callers fall back to the
+    padded path): x-sharded pre-padded windows only (``x_halo`` set,
+    ``y_halo`` not — an h-thin y shell has no legal sublane blocking),
+    no ``sum_defs`` (the region split would change the deterministic
+    reduction order), and ``X >= 3h`` so an interior exists.
+    """
+
+    def __init__(self, st, h):
+        from pystella_tpu.parallel.overlap import MIN_INTERIOR_FACTOR
+        if st.sum_defs:
+            raise ValueError(
+                "sum outputs: the interior/shell split would change the "
+                "deterministic reduction order")
+        if not st.x_halo or st.y_halo:
+            raise ValueError(
+                "overlap split supports x-sharded (x_halo) windows only")
+        X, Y, Z = st.lattice_shape
+        self.h = int(h)
+        if X < MIN_INTERIOR_FACTOR * self.h:
+            raise ValueError(
+                f"local x extent {X} thinner than "
+                f"{MIN_INTERIOR_FACTOR}*h: no interior to hide the "
+                "transfer behind")
+        self.st = st
+        self.st_interior = st.with_lattice((X - 2 * self.h, Y, Z),
+                                           by=st.by)
+        self.st_shell = st.with_lattice((self.h, Y, Z), bx=self.h,
+                                        by=st.by)
+
+    @staticmethod
+    def _slice_x(tree, s, e):
+        if tree is None:
+            return None
+        out = {}
+        for n, a in tree.items():
+            nd = getattr(a, "ndim", 0)
+            if nd < 3:
+                out[n] = a
+            else:
+                out[n] = lax.slice_in_dim(a, s, e, axis=nd - 3)
+        return out
+
+    def __call__(self, f, decomp, scalars=None, extras=None):
+        """Run the three launches inside a ``shard_map`` body. ``f`` is
+        the RAW (unpadded) local window input — a single ``(C, X, Y,
+        Z)`` array or a dict matching ``win_defs``; ``decomp`` issues
+        the slab ``ppermute``s. Returns the same dict of full-block
+        outputs as the padded ``StreamingStencil.__call__``."""
+        h = self.h
+        X = self.st.lattice_shape[0]
+        single = not isinstance(f, dict)
+        wins = {"f": f} if single else f
+
+        def xsl(a, s, e):
+            return lax.slice_in_dim(a, s, e, axis=a.ndim - 3)
+
+        with trace_scope("halo_overlap"):
+            # slab ppermutes first: program order hands the scheduler
+            # the dependence-free interior launch to hide them behind
+            slabs = {n: decomp.exchange_slabs(a, 0, h)
+                     for n, a in wins.items()}
+            with trace_scope("halo_overlap_interior"):
+                int_out = self.st_interior(
+                    f, scalars=scalars,
+                    extras=self._slice_x(extras, h, X - h))
+            with trace_scope("halo_overlap_shells"):
+                low_in = {n: lax.concatenate(
+                    [slabs[n][0], xsl(a, 0, 2 * h)],
+                    dimension=a.ndim - 3) for n, a in wins.items()}
+                high_in = {n: lax.concatenate(
+                    [xsl(a, X - 2 * h, X), slabs[n][1]],
+                    dimension=a.ndim - 3) for n, a in wins.items()}
+                low_out = self.st_shell(
+                    low_in["f"] if single else low_in, scalars=scalars,
+                    extras=self._slice_x(extras, 0, h))
+                high_out = self.st_shell(
+                    high_in["f"] if single else high_in, scalars=scalars,
+                    extras=self._slice_x(extras, X - h, X))
+        out = {}
+        for n in self.st.out_defs:
+            ax = low_out[n].ndim - 3
+            out[n] = lax.concatenate(
+                [low_out[n], int_out[n], high_out[n]], dimension=ax)
         return out

@@ -1,4 +1,6 @@
-from pystella_tpu.parallel.decomp import DomainDecomposition, make_mesh
-from pystella_tpu.parallel import multihost
+from pystella_tpu.parallel.decomp import (
+    DomainDecomposition, HaloShells, make_mesh)
+from pystella_tpu.parallel import multihost, overlap
 
-__all__ = ["DomainDecomposition", "make_mesh", "multihost"]
+__all__ = ["DomainDecomposition", "HaloShells", "make_mesh",
+           "multihost", "overlap"]

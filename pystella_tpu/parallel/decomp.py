@@ -36,8 +36,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pystella_tpu import _compat
+from pystella_tpu.obs.scope import trace_scope
+from pystella_tpu.parallel.overlap import MIN_INTERIOR_FACTOR
 
-__all__ = ["DomainDecomposition", "make_mesh"]
+__all__ = ["DomainDecomposition", "HaloShells", "make_mesh"]
 
 
 def make_mesh(proc_shape=None, axis_names=("x", "y", "z"), devices=None):
@@ -85,6 +87,12 @@ class DomainDecomposition:
             halo_shape = (halo_shape,) * 3
         self.halo_shape = tuple(int(h) for h in halo_shape)
         self._share_halos_cache = {}
+        # per-execution ICI bytes of each DISTINCT halo program traced
+        # through this decomposition, recorded at trace-cache-miss time
+        # (a traced pad runs once per consumer compile, so executions
+        # cannot be counted here — this is the static per-call figure;
+        # obs counter "halo_bytes_exchanged" accumulates the same)
+        self._halo_program_bytes = {}
 
     # -- shardings ---------------------------------------------------------
 
@@ -201,7 +209,54 @@ class DomainDecomposition:
         size = self.mesh.shape[axis_name]
         return [(i, (i + shift) % size) for i in range(size)]
 
-    def pad_with_halos(self, x, halo, lattice_axes=None, exchange=None):
+    # -- halo traffic accounting -------------------------------------------
+
+    def halo_bytes(self, shape, itemsize, halo, exchange=None,
+                   lattice_axes=None):
+        """Interconnect bytes ONE execution of a halo exchange with
+        these parameters moves: two ``exchange[d]``-wide slabs per
+        sharded axis (alignment rows beyond ``exchange`` are local
+        zeros and move nothing; unsharded axes wrap locally). Mirrors
+        the sequential exchange of :meth:`pad_with_halos` — later axes'
+        slabs include earlier axes' padding."""
+        if lattice_axes is None:
+            lattice_axes = tuple(range(len(shape) - len(halo), len(shape)))
+        extents = list(shape)
+        total = 0
+        for d, ax in enumerate(lattice_axes):
+            h = halo[d]
+            if h == 0:
+                continue
+            e = min(int(exchange[d]), h) if exchange is not None else h
+            if self.proc_shape[d] > 1 and e > 0:
+                slab = int(itemsize) * e
+                for a, n in enumerate(extents):
+                    if a != ax:
+                        slab *= int(n)
+                total += 2 * slab
+            extents[ax] += 2 * h
+        return total
+
+    def _record_halo_bytes(self, key, nbytes):
+        """Trace-cache-miss accounting: the first time a distinct halo
+        program is traced, its per-execution ICI bytes land in the
+        ``halo_bytes_exchanged`` counter and in
+        :attr:`_halo_program_bytes` (see :meth:`traced_halo_bytes`)."""
+        if not nbytes or key in self._halo_program_bytes:
+            return
+        self._halo_program_bytes[key] = nbytes
+        from pystella_tpu.obs import metrics as _metrics
+        _metrics.counter("halo_bytes_exchanged").inc(nbytes)
+
+    def traced_halo_bytes(self):
+        """Total per-execution ICI bytes over every distinct halo
+        program traced through this decomposition so far — the
+        ``bytes_per_step`` figure a driver that runs one such program
+        per step can hand to the perf ledger (``halo_traffic`` event)."""
+        return sum(self._halo_program_bytes.values())
+
+    def pad_with_halos(self, x, halo, lattice_axes=None, exchange=None,
+                       overlap=False):
         """Return ``x`` padded with periodic halos of width ``halo[d]`` along
         each lattice axis.
 
@@ -225,17 +280,90 @@ class DomainDecomposition:
         bench_results/r05_scaling_model.md) without touching the
         Mosaic-clean buffer layout. Callers must guarantee no tap reads
         beyond ``exchange[d]`` (stencil taps reach at most the radius).
+
+        With ``overlap=True`` the padded block is instead returned SPLIT
+        for communication/computation overlap, as ``(interior,
+        shells)``: ``interior`` is ``x`` padded along the axes that need
+        no interconnect traffic only (pure local data — a stencil
+        applied to it yields the radius-``halo`` inset of the block,
+        with no dependence on the collectives), and ``shells`` is a
+        :class:`HaloShells` carrying the fully assembled padded block
+        plus the region bookkeeping to compute the boundary shells (two
+        per split axis) and stitch them around the interior. Requires
+        trailing lattice axes and raises ``ValueError`` when no overlap
+        split exists (nothing sharded, a sharded z axis, or a block
+        thinner than ``MIN_INTERIOR_FACTOR * halo`` along a sharded
+        axis — see :meth:`split_axes`) — use :meth:`overlap_stencil`
+        for the driver that degrades to the padded path instead.
         """
+        halo, exchange = self._canon_halo(halo, exchange)
+        if lattice_axes is None:
+            lattice_axes = tuple(range(x.ndim - len(self.axis_names), x.ndim))
+        if overlap:
+            return self._overlap_split(x, halo, lattice_axes, exchange)
+        key = (tuple(x.shape), str(x.dtype), halo, exchange,
+               tuple(lattice_axes))
+        self._record_halo_bytes(key, self.halo_bytes(
+            x.shape, np.dtype(x.dtype).itemsize, halo, exchange,
+            lattice_axes))
+        with jax.named_scope("halo_exchange"):
+            return self._pad_with_halos(x, halo, lattice_axes, exchange)
+
+    def _canon_halo(self, halo, exchange):
         if np.isscalar(halo):
             halo = (halo,) * len(self.axis_names)
+        halo = tuple(int(h) for h in halo)
         if exchange is None:
             exchange = halo
         elif np.isscalar(exchange):
             exchange = (exchange,) * len(self.axis_names)
-        if lattice_axes is None:
-            lattice_axes = tuple(range(x.ndim - len(self.axis_names), x.ndim))
-        with jax.named_scope("halo_exchange"):
-            return self._pad_with_halos(x, halo, lattice_axes, exchange)
+        return halo, tuple(int(e) for e in exchange)
+
+    def comm_axes(self, halo):
+        """Lattice axes whose halos actually ride the interconnect."""
+        return tuple(d for d in range(len(self.axis_names))
+                     if self.proc_shape[d] > 1 and halo[d] > 0)
+
+    def split_axes(self, halo, shape):
+        """The axes the interior/shell split divides, or ``()`` when the
+        configuration must keep the padded path. The split is
+        all-or-nothing over the communicated axes, and only x/y
+        qualify: a sharded z (minor) axis — whether split into shells
+        or exchanged up front as a concat into the interior input —
+        was measured to shift the CPU backend's FMA contraction on
+        sliced minor-axis pieces by ~1 ulp, breaking the bit-exactness
+        contract, so any z communication sends the whole op down the
+        padded path (the production pallas/fused layouts keep z whole
+        per device anyway). Each split axis must also span at least
+        ``MIN_INTERIOR_FACTOR * halo`` sites, or there is no interior
+        to hide the transfer behind."""
+        comm = self.comm_axes(halo)
+        if not comm or 2 in comm:
+            return ()
+        if any(shape[d] < MIN_INTERIOR_FACTOR * halo[d] for d in comm):
+            return ()
+        return comm
+
+    def _overlap_split(self, x, halo, lattice_axes, exchange):
+        if tuple(lattice_axes) != tuple(range(x.ndim - 3, x.ndim)):
+            raise ValueError("overlap split requires trailing lattice axes")
+        shape = tuple(x.shape[-3:])
+        split = self.split_axes(halo, shape)
+        if not split:
+            raise ValueError(
+                f"no overlappable axis for block {shape} with halo "
+                f"{halo} on mesh {self.proc_shape}: needs a sharded x/y "
+                f"axis spanning >= {MIN_INTERIOR_FACTOR}*halo (the z "
+                "axis is never split; see split_axes)")
+        # trace the exchange FIRST so the collective starts are issued
+        # ahead of the interior compute they will overlap with
+        padded = self.pad_with_halos(x, halo, exchange=exchange)
+        local_halo = tuple(0 if d in split else halo[d] for d in range(3))
+        local_ex = tuple(0 if d in split else exchange[d]
+                         for d in range(3))
+        interior = self._pad_with_halos(
+            x, local_halo, lattice_axes, local_ex)
+        return interior, HaloShells(padded, halo, split, shape)
 
     def _pad_with_halos(self, x, halo, lattice_axes, exchange):
         for d, ax in enumerate(lattice_axes):
@@ -279,6 +407,98 @@ class DomainDecomposition:
             x = lax.concatenate([left_halo, x, right_halo], dimension=ax)
         return x
 
+    def exchange_slabs(self, x, d, width, lattice_axes=None):
+        """``(left_halo, right_halo)`` slabs of ``width`` rows along
+        lattice axis ``d``, moved with periodic ``lax.ppermute`` — the
+        issue-first half of the overlapped Pallas tier (the shells are
+        assembled by the caller once the collectives land). MUST be
+        called from inside a ``shard_map``; ``d`` must be a sharded
+        axis."""
+        if lattice_axes is None:
+            lattice_axes = tuple(range(x.ndim - len(self.axis_names), x.ndim))
+        ax = lattice_axes[d]
+        name = self.axis_names[d]
+        lo = lax.slice_in_dim(x, x.shape[ax] - width, x.shape[ax], axis=ax)
+        hi = lax.slice_in_dim(x, 0, width, axis=ax)
+        key = ("slabs", tuple(x.shape), str(x.dtype), d, width)
+        nbytes = 2 * int(width) * np.dtype(x.dtype).itemsize * int(
+            np.prod([n for a, n in enumerate(x.shape) if a != ax]))
+        self._record_halo_bytes(key, nbytes)
+        with jax.named_scope("halo_exchange"):
+            left_halo = lax.ppermute(lo, name, self._perm(name, +1))
+            right_halo = lax.ppermute(hi, name, self._perm(name, -1))
+        return left_halo, right_halo
+
+    def overlap_stencil(self, xs, halo, apply_fn, extras=None,
+                        exchange=None, overlap=True):
+        """Apply a radius-``halo`` stencil with the halo exchange
+        overlapped behind the interior compute.
+
+        ``xs`` is a pytree of arrays with identical trailing 3 lattice
+        axes; ``apply_fn(padded_xs[, extras])`` must treat its first
+        argument as the halo-padded block (every lattice axis grown by
+        ``2 * halo[d]``), return a pytree of outputs with trailing
+        lattice axes equal to the unpadded extent, and be ELEMENTWISE
+        over lattice sites (taps plus pointwise math — no cross-site
+        reductions, whose order the region split would change).
+        ``extras`` is an optional pytree of same-lattice unpadded
+        arrays (plus scalars, passed through untouched) sliced to each
+        computed region.
+
+        The split: the ``ppermute``s are traced first; the interior
+        (radius-``halo`` inset along communicated axes) is computed
+        from purely local data while the collectives are in flight;
+        the boundary shells are computed from the assembled padded
+        block once halos land and stitched around the interior. The
+        result is BIT-EXACT with the padded path at the operator
+        output — identical tap offsets and per-element reduction order
+        (pinned by tests/test_overlap.py) — so callers may flip
+        ``overlap`` freely; infeasible configurations (nothing sharded,
+        a communicated z axis, blocks thinner than
+        ``MIN_INTERIOR_FACTOR * halo``) silently take the padded path.
+        One scoping note: when the output feeds FURTHER pointwise
+        arithmetic inside the same jit, the backend may contract FMAs
+        differently across the stitch boundaries (~1 ulp per step,
+        measured on CPU f64) — the same class of difference as any
+        fusion-boundary change, not a reordering of the stencil math."""
+        halo, exchange = self._canon_halo(halo, exchange)
+        tm = jax.tree_util.tree_map
+        leaves = jax.tree_util.tree_leaves(xs)
+        shape = tuple(leaves[0].shape[-3:])
+        split = self.split_axes(halo, shape) if overlap else ()
+
+        def call(padded_xs, region):
+            if extras is None:
+                return apply_fn(padded_xs)
+            return apply_fn(padded_xs, _slice_region(extras, region))
+
+        if not split:
+            padded = tm(lambda a: self.pad_with_halos(
+                a, halo, exchange=exchange), xs)
+            return call(padded, None)
+
+        with trace_scope("halo_overlap"):
+            # exchange first: the collective starts precede the interior
+            # compute in program order, handing the latency-hiding
+            # scheduler the dependence-free work to hide them behind
+            padded = tm(lambda a: self.pad_with_halos(
+                a, halo, exchange=exchange), xs)
+            shells = HaloShells(padded, halo, split, shape)
+            local_halo = tuple(0 if d in split else halo[d]
+                               for d in range(3))
+            local_ex = tuple(0 if d in split else exchange[d]
+                             for d in range(3))
+            with trace_scope("halo_overlap_interior"):
+                interior_in = tm(
+                    lambda a: self._pad_with_halos(
+                        a, local_halo,
+                        tuple(range(a.ndim - 3, a.ndim)), local_ex), xs)
+                interior_out = call(interior_in, shells.interior_region())
+            with trace_scope("halo_overlap_shells"):
+                shell_outs = [call(inp, reg) for inp, reg in
+                              zip(shells.inputs(), shells.regions())]
+            return shells.stitch(interior_out, shell_outs)
+
     def share_halos(self, array, halo, outer_axes=0):
         """Standalone halo exchange on a global array: returns the *padded*
         global array (shape grown by ``2*halo`` per axis). Mostly useful for
@@ -288,11 +508,14 @@ class DomainDecomposition:
         if np.isscalar(halo):
             halo = (halo,) * len(self.axis_names)
         halo = tuple(int(h) for h in halo)
-        # exact host-level count (pad_with_halos itself runs at trace
+        # exact host-level count of the per-axis exchanges this call
+        # actually issues: only sharded axes with a nonzero halo ride
+        # ppermute — unsharded axes wrap locally and an unsharded mesh
+        # exchanges nothing at all (pad_with_halos itself runs at trace
         # time inside jitted consumers, where a counter would tally
         # traces, not executions)
         from pystella_tpu.obs import metrics as _metrics
-        _metrics.counter("halo_exchanges").inc()
+        _metrics.counter("halo_exchanges").inc(len(self.comm_axes(halo)))
         fn = self._share_halos_cache.get((halo, outer_axes))
         if fn is None:
             spec = self.spec(outer_axes)
@@ -333,3 +556,101 @@ class DomainDecomposition:
 
     def __repr__(self):
         return f"DomainDecomposition(proc_shape={self.proc_shape})"
+
+
+def _slice_region(tree, region):
+    """Slice every lattice-shaped leaf (ndim >= 3, trailing lattice
+    axes) of ``tree`` to the block-coordinate ``region`` (three
+    ``(start, stop)`` pairs); scalars and low-rank leaves pass through
+    untouched. ``region=None`` means the full block."""
+    if tree is None or region is None:
+        return tree
+
+    def cut(a):
+        nd = getattr(a, "ndim", 0)
+        if nd < 3:
+            return a
+        idx = [slice(None)] * nd
+        for d, (s, e) in enumerate(region):
+            idx[nd - 3 + d] = slice(s, e)
+        return a[tuple(idx)]
+
+    return jax.tree_util.tree_map(cut, tree)
+
+
+class HaloShells:
+    """The shells half of the overlapped halo-exchange contract
+    (:meth:`DomainDecomposition.pad_with_halos` with ``overlap=True``).
+
+    Holds the fully assembled padded block(s) — the part that waits on
+    the collectives — plus the bookkeeping that partitions the
+    radius-``halo`` boundary into ``2 * len(comm_axes)`` shells (an
+    onion partition: the shell pair of the k-th communicated axis spans
+    the interior of earlier communicated axes and the full extent of
+    everything else, so shells tile the boundary exactly once) and
+    stitches shell outputs around an independently computed interior.
+
+    All lattice axes are trailing, in both inputs and outputs.
+    """
+
+    def __init__(self, padded, halo, comm_axes, block_shape):
+        self.padded = padded
+        self.halo = tuple(halo)
+        self.comm_axes = tuple(comm_axes)
+        self.block_shape = tuple(block_shape)
+
+    def interior_region(self):
+        """Block-coordinate region the interior compute covers: the
+        radius-``halo`` inset along communicated axes, full extent
+        elsewhere."""
+        return tuple(
+            (self.halo[d], self.block_shape[d] - self.halo[d])
+            if d in self.comm_axes else (0, self.block_shape[d])
+            for d in range(3))
+
+    def regions(self):
+        """Output regions (block coordinates) of the shells, ordered
+        ``(low, high)`` per communicated axis."""
+        out = []
+        for k, d in enumerate(self.comm_axes):
+            n, h = self.block_shape[d], self.halo[d]
+            for bounds in ((0, h), (n - h, n)):
+                region = []
+                for a in range(3):
+                    na, ha = self.block_shape[a], self.halo[a]
+                    if a == d:
+                        region.append(bounds)
+                    elif a in self.comm_axes[:k]:
+                        region.append((ha, na - ha))
+                    else:
+                        region.append((0, na))
+                out.append(tuple(region))
+        return out
+
+    def inputs(self):
+        """One padded input block per shell — its stencil footprint:
+        output rows ``[a, b)`` along an axis read padded rows
+        ``[a, b + 2*halo)``."""
+        ins = []
+        for region in self.regions():
+            def cut(p, region=region):
+                idx = [slice(None)] * p.ndim
+                for a, (s, e) in enumerate(region):
+                    idx[p.ndim - 3 + a] = slice(s, e + 2 * self.halo[a])
+                return p[tuple(idx)]
+            ins.append(jax.tree_util.tree_map(cut, self.padded))
+        return ins
+
+    def stitch(self, interior_out, shell_outs):
+        """Concatenate the shell outputs around the interior, innermost
+        communicated axis first — the inverse of the onion partition.
+        Works on matching pytrees of outputs (trailing lattice axes)."""
+        res = interior_out
+        for k in range(len(self.comm_axes) - 1, -1, -1):
+            d = self.comm_axes[k]
+            low, high = shell_outs[2 * k], shell_outs[2 * k + 1]
+            res = jax.tree_util.tree_map(
+                lambda lo, mid, hi, d=d: lax.concatenate(
+                    [lo, mid, hi], dimension=mid.ndim - 3 + d),
+                low, res, high)
+        return res

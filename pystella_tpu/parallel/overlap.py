@@ -1,0 +1,140 @@
+"""Communication/computation overlap policy for sharded stencil updates.
+
+Every sharded stencil update used to serialize on its halo exchange:
+``Decomposition.pad_with_halos`` issues ``lax.ppermute`` on boundary
+slabs, concatenates the padded block, and only then does the stencil
+run — so ICI latency sat directly on the step critical path (visible as
+the ``halo`` scope fraction in ``perf_report.md``). The overlapped path
+splits each update into an *interior* region (radius-``h`` inset — needs
+no remote data) and boundary *shells*, issues the ``ppermute``s first,
+computes the interior while the collectives are in flight, then computes
+and stitches the shells once halos land. XLA's latency-hiding scheduler
+can then genuinely hide the transfer behind the interior work — the
+canonical optimization for distributed finite-difference solvers
+(Devito's MPI-X "computation/communication overlap", arxiv 2312.13094;
+the interior/boundary split of arxiv 2309.04671).
+
+This module is the POLICY side:
+
+- :func:`enabled` — resolves whether a given mesh takes the overlapped
+  path: per-call/constructor override > ``PYSTELLA_HALO_OVERLAP`` env
+  (``1``/``0``/``auto``) > auto (on for sharded meshes, i.e. >1 rank on
+  any lattice axis).
+- :func:`ensure_scheduler_flags` — sets the async-collective /
+  latency-hiding-scheduler flags the overlap needs to pay off on TPU
+  (``LIBTPU_INIT_ARGS``; must run before the backend initializes).
+- :func:`flags_fingerprint` — the scheduler-relevant flags currently in
+  the environment, recorded into ``perf_report.json``'s environment
+  fingerprint so two reports that differ only in scheduler flags are
+  flagged by the gate (warning, not refusal).
+
+The MECHANISM lives in
+:meth:`~pystella_tpu.DomainDecomposition.overlap_stencil` (XLA-stencil
+tier) and :class:`~pystella_tpu.ops.pallas_stencil.OverlapStreamingStencil`
+(Pallas tier); when overlap cannot help (unsharded meshes, blocks
+thinner than ``3h``, y/z-sharded Pallas tiles, reduction-emitting
+kernels) every consumer falls back to the padded path — the two paths
+are bit-exact, so the choice is pure scheduling.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["enabled", "env_setting", "ensure_scheduler_flags",
+           "flags_fingerprint", "SCHEDULER_FLAGS", "MIN_INTERIOR_FACTOR"]
+
+#: a block must span at least ``MIN_INTERIOR_FACTOR * h`` sites along a
+#: communicated axis for the interior/shell split to leave a non-empty
+#: interior worth hiding the transfer behind (two h-deep shells + at
+#: least h interior rows); thinner blocks take the padded path.
+MIN_INTERIOR_FACTOR = 3
+
+#: flags handed to libtpu so XLA's scheduler can actually hide the
+#: ppermutes the overlapped path makes hideable: async collective
+#: permutes (the collective start/done pair the scheduler reorders
+#: around) and the latency-hiding scheduler itself. Recorded into the
+#: perf-report environment fingerprint either way — a baseline measured
+#: without them is not comparable to one measured with them.
+SCHEDULER_FLAGS = (
+    "--xla_tpu_enable_async_collective_permute=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+)
+
+#: env-var name substrings that make a flag scheduler-relevant for the
+#: fingerprint (kept deliberately broad: any async-collective or
+#: latency-hiding toggle changes what a step-time comparison means)
+_FLAG_MARKERS = ("async_collective", "async_all_gather",
+                 "latency_hiding", "scheduler")
+
+
+def env_setting():
+    """The raw ``PYSTELLA_HALO_OVERLAP`` setting: ``True``/``False`` for
+    an explicit 1/0, ``None`` for unset/auto."""
+    val = os.environ.get("PYSTELLA_HALO_OVERLAP", "auto").strip().lower()
+    if val in ("1", "true", "on", "yes"):
+        return True
+    if val in ("0", "false", "off", "no"):
+        return False
+    if val not in ("", "auto"):
+        logger.warning("PYSTELLA_HALO_OVERLAP=%r not understood; "
+                       "treating as 'auto'", val)
+    return None
+
+
+def enabled(decomp=None, override=None):
+    """Should stencil consumers on ``decomp``'s mesh take the overlapped
+    halo path? Resolution order: explicit per-call/constructor
+    ``override`` > ``PYSTELLA_HALO_OVERLAP`` env > auto (on exactly when
+    the mesh shards at least one lattice axis — there is nothing to
+    overlap on a single-rank mesh)."""
+    if override is not None:
+        return bool(override)
+    env = env_setting()
+    if env is not None:
+        return env
+    if decomp is None:
+        return False
+    return any(p > 1 for p in decomp.proc_shape)
+
+
+def ensure_scheduler_flags(env=os.environ):
+    """Append :data:`SCHEDULER_FLAGS` to ``LIBTPU_INIT_ARGS`` (idempotent
+    per flag name). Only effective when called BEFORE the TPU backend
+    initializes (libtpu reads the variable once at init); harmless on
+    CPU backends, which never read it. Returns the flags added."""
+    current = env.get("LIBTPU_INIT_ARGS", "")
+    added = []
+    for flag in SCHEDULER_FLAGS:
+        name = flag.split("=", 1)[0]
+        if name not in current:
+            added.append(flag)
+    if added:
+        env["LIBTPU_INIT_ARGS"] = " ".join(
+            ([current] if current else []) + added)
+        logger.info("halo overlap: added scheduler flags to "
+                    "LIBTPU_INIT_ARGS: %s", " ".join(added))
+    return added
+
+
+def flags_fingerprint(env=os.environ):
+    """The scheduler-relevant flags active in this process's
+    environment, as ``{flag_name: value}`` — parsed from ``XLA_FLAGS``
+    and ``LIBTPU_INIT_ARGS`` (stdlib-only; the perf ledger embeds this
+    in every report's environment fingerprint). Also records the
+    overlap policy env itself, so a report says whether the overlapped
+    code path was even eligible."""
+    flags = {}
+    for var in ("XLA_FLAGS", "LIBTPU_INIT_ARGS"):
+        for tok in env.get(var, "").split():
+            name, _, value = tok.lstrip("-").partition("=")
+            if any(m in name for m in _FLAG_MARKERS):
+                flags[name] = value if value else "true"
+    setting = env.get("PYSTELLA_HALO_OVERLAP")
+    if setting is not None:
+        flags["PYSTELLA_HALO_OVERLAP"] = setting
+    return flags

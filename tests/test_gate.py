@@ -315,7 +315,16 @@ def test_smoke_to_gate_end_to_end(tmp_path):
     assert rep["env"]["platform"] == "cpu" and rep["env"]["jax"]
     # the profiler capture parsed into a real per-scope breakdown
     assert rep["scopes"].get("bench_step", {}).get("count") == 12
-    assert os.path.exists(os.path.join(out, "perf_report.md"))
+    # ... including the overlapped-halo payload's scope names and the
+    # ledger's exposed-vs-hidden communication derivation
+    assert rep["scopes"].get("halo_overlap", {}).get("count") == 6
+    assert rep["scopes"].get("collective-permute", {}).get("count")
+    assert rep["overlap"]["comm_ms"] > 0
+    assert rep["overlap"]["exposed_ms"] is not None
+    assert rep["overlap"]["halo_bytes_per_step"] > 0
+    assert rep["env"].get("xla_flags") is not None
+    md = open(os.path.join(out, "perf_report.md")).read()
+    assert "Communication overlap" in md and "exposed" in md
     # the event log behind it holds the full pipeline record
     kinds = {r["kind"] for r in events.read_events(
         os.path.join(out, "smoke_events.jsonl"))}
