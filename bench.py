@@ -267,12 +267,18 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
 
     if not make_state:  # callers supplying their own initial state
         return stepper, None, dt
+    # fluctuation amplitudes small enough that the g^2 phi^2 chi^2
+    # coupling (g^2/m_phi^2 ~ 1.7e5) keeps the run FINITE: the original
+    # 0.1/0.01 amplitudes blew up to NaN within ~3 steps, which nothing
+    # noticed for five rounds because only step TIMES were measured —
+    # the numerics sentinel (obs.sentinel) caught it the first time it
+    # ran, and now trips the smoke run if this regresses
     rng = np.random.default_rng(7)
     state = {
         "f": decomp.shard(
-            0.1 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
+            1e-3 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
         "dfdt": decomp.shard(
-            0.01 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
+            1e-4 * rng.standard_normal((2,) + grid_shape).astype(dtype)),
     }
     return stepper, state, dt
 
@@ -746,7 +752,9 @@ def run_smoke(argv=None):
 
     - ``smoke_events.jsonl`` — the structured run record (per-step
       ``step_time`` events, the step executable's ``compile`` report,
-      a ``trace_summary`` from a real ``jax.profiler`` capture);
+      a ``trace_summary`` from a real ``jax.profiler`` capture, and
+      per-step ``health`` events from the async numerics sentinel —
+      the report's ``numerics`` section derives from them);
     - ``perf_report.json`` + ``perf_report.md`` — the
       :class:`pystella_tpu.obs.ledger.PerfLedger` output the regression
       gate consumes.
@@ -811,6 +819,23 @@ def run_smoke(argv=None):
         state = compiled(state, t, dt, rhs_args)
     sync(state)
 
+    # numerics sentinel: a per-step health vector (per-field finite/
+    # max-abs/rms + a kinetic-energy invariant) observed asynchronously
+    # — poll only ever converts vectors >= 4 steps behind — so the
+    # smoke report's `numerics` section (invariant drift slope,
+    # sentinel overhead) and the `health` event schema are exercised
+    # end to end by smoke -> ledger -> gate (tests/test_gate.py)
+    import jax.numpy as jnp
+    sentinel = obs.Sentinel.for_state(state, invariants={
+        "kinetic_mean": lambda st, aux: 0.5 * jnp.mean(
+            jnp.sum(jnp.square(st["dfdt"]), axis=0))})
+    smon = obs.SentinelMonitor(sentinel, every=4, history=64,
+                               emit_steps=True, label="smoke")
+    # compile the (tiny) health computation outside the timed loop, like
+    # the step warmup above — the `sentinel` metrics timer should
+    # measure steady-state overhead, not one jit compile
+    jax.block_until_ready(sentinel.compute_jit(state))
+
     # overlapped-halo payload: a sharded-mesh Laplacian through the
     # interior/shell split (PYSTELLA_HALO_OVERLAP / FiniteDifferencer
     # overlap=True), so the smoke report exercises the halo_overlap
@@ -837,16 +862,22 @@ def run_smoke(argv=None):
                                  label="smoke"))
     with capture:
         steptimer.tick()  # arm the clock
-        for _ in range(args.steps):
+        for i in range(args.steps):
             with obs.trace_scope("bench_step"):
                 state = compiled(state, t, dt, rhs_args)
                 sync(state)
             steptimer.tick()
+            smon.observe(i + 1, state)
+            smon.poll()
         if overlap_seg is not None:
             odec, ofd, ox = overlap_seg
             for _ in range(6):
                 with obs.trace_scope("halo_overlap"):
                     sync(ofd.lap(ox))
+
+    # drain the sentinel queue: the trailing <4 health vectors land in
+    # the event log before the ledger ingests it
+    smon.flush()
 
     if overlap_seg is not None:
         # per-device ICI bytes one overlapped call moves — computed by

@@ -74,6 +74,19 @@ parser.add_argument("--checkpoint-dir", type=str, default=None,
                     help="enable checkpoint/resume under this directory")
 parser.add_argument("--checkpoint-interval", type=int, default=100,
                     metavar="STEPS")
+parser.add_argument("--health-every", type=int, default=50,
+                    metavar="STEPS",
+                    help="poll lag of the async numerics sentinel: the "
+                    "driver observes a health vector every iteration "
+                    "(no sync) and only ever blocks on one at least "
+                    "this many steps behind (doc/observability.md "
+                    "'Numerics health')")
+parser.add_argument("--forensics-dir", type=str, default="forensics",
+                    metavar="DIR",
+                    help="where a forensic bundle is written when the "
+                    "sentinel trips (last-K health vectors, event-log "
+                    "tail, config/env fingerprint, last-good-checkpoint"
+                    " pointer); only created on divergence")
 parser.add_argument("--event-log", type=str, default=None,
                     metavar="PATH", help="structured JSONL run-event log"
                     " (doc/observability.md); PYSTELLA_EVENT_LOG also"
@@ -314,9 +327,19 @@ def main(argv=None):
     # configured, and give the PerfLedger its step-time distribution
     # when one is (--event-log / PYSTELLA_EVENT_LOG)
     steptimer = ps.StepTimer(report_every=30.0, emit_steps=True)
-    # check at least as often as checkpoints are written so a diverged
-    # state is never saved
-    monitor = ps.HealthMonitor(every=50)
+    # async numerics sentinel: a per-iteration health vector (one tiny
+    # fused dispatch, no sync) polled with a lag of health_every steps,
+    # so the device queue never drains for a health check; a sync
+    # check_now still guards every checkpoint save. On a trip the
+    # forensic bundle is written before SimulationDiverged propagates.
+    monitor = ps.HealthMonitor(every=p.health_every)
+    monitor.forensics = ps.obs.ForensicSink(
+        p.forensics_dir, events_path=ps.obs.get_log().path,
+        checkpoint=ckpt, config={k: v for k, v in vars(p).items()
+                                 if isinstance(v, (bool, int, float,
+                                                   str, tuple, list,
+                                                   type(None)))},
+        label="scalar_preheating")
 
     # --profile: jax.profiler capture of a mid-run step window (entered
     # once compilation has settled), parsed into per-scope durations on
@@ -380,25 +403,32 @@ def main(argv=None):
                 profiler.__exit__(None, None, None)
                 profiler, profile_done = None, True
             output(step_count, t, energy, expand, state)
-            # a NaN state must never be checkpointed: saves happen exactly
-            # on the requested interval, each preceded by a health check
-            # (the periodic monitor alone would let saves drift to later
-            # steps when the interval isn't a multiple of its cadence)
-            # chunked runs step past exact interval multiples, so both
-            # the periodic NaN check and the checkpoint fire whenever
-            # this advance CROSSED a multiple (for stride 1 this is
-            # exactly the step_count % interval == 0 cadence)
+            # host-side model invariants ride the same health record the
+            # sentinel's field stats land in: the ledger's numerics
+            # section derives invariant drift slopes from these, and the
+            # gate fails CI when the constraint drifts worse than the
+            # baseline (doc/observability.md "Numerics health")
+            ps.obs.emit("health", step=step_count, invariants={
+                "constraint": float(expand.constraint(energy["total"])),
+                "energy_total": float(np.sum(energy["total"]))})
+            # async numerics sentinel: observe dispatches one tiny fused
+            # reduction (no sync); poll only ever converts vectors at
+            # least health_every steps behind, so the driver loop stays
+            # that far ahead of any device->host transfer
+            monitor.observe(step_count, state)
+            monitor.poll()
+            # a NaN state must never be checkpointed: every save is
+            # preceded by a SYNCHRONOUS health check of the exact state
+            # being saved (the async poll lags by design); chunked runs
+            # step past exact interval multiples, so the checkpoint
+            # fires whenever this advance CROSSED a multiple (for
+            # stride 1 this is the step_count % interval == 0 cadence)
             prev = step_count - (p.chunk_steps or 1)
-            checked = (step_count // monitor.every
-                       > prev // monitor.every)
-            if checked:
-                monitor.check_now(state)
             save_due = (ckpt is not None
                         and step_count // p.checkpoint_interval
                         > prev // p.checkpoint_interval)
             if save_due:
-                if not checked:
-                    monitor.check_now(state)
+                monitor.check_now(state, step=step_count)
                 # force=True: orbax's interval policy would drop saves at
                 # non-multiple steps (chunked crossings)
                 ckpt.save(step_count, state, metadata={
@@ -413,8 +443,10 @@ def main(argv=None):
                       f"{ms_per_step:<15.3f}", f"{steps_per_s:<15.3f}")
 
         # normal completion (incl. silent NaN-exit from the while
-        # condition): verify health before the final checkpoint
-        monitor.check_now(state)
+        # condition): drain the async queue, then verify the FINAL
+        # state synchronously before the final checkpoint
+        monitor.flush()
+        monitor.check_now(state, step=step_count)
         if ckpt is not None and ckpt.latest_step != step_count:
             ckpt.save(step_count, state, metadata={
                 "t": t, "a": float(expand.a), "adot": float(expand.adot),

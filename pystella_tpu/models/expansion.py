@@ -97,3 +97,15 @@ class Expansion:
         """Dimensionless violation of Friedmann 1 as an evolution constraint
         (reference expansion.py:159-176)."""
         return np.abs(self.adot_friedmann_1(self.a, energy) / self.adot - 1)
+
+    def constraint_residual(self, a, adot, energy):
+        """The same Friedmann-1 residual as :meth:`constraint`, but
+        computed from explicit ``(a, adot, energy)`` using only
+        power/abs arithmetic — traceable, so it runs *inside* a jitted
+        step as a numerics-sentinel invariant
+        (:mod:`pystella_tpu.obs.sentinel`), e.g. against the on-device
+        background of an energy-coupled chunk
+        (``FusedScalarStepper.coupled_multi_step`` passes ``a``/``adot``
+        in the sentinel's ``aux``)."""
+        adot_f1 = (8 * np.pi * a**2 / 3 / self.mpl**2 * energy) ** 0.5 * a
+        return abs(adot_f1 / adot - 1)

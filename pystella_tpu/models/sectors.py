@@ -93,6 +93,37 @@ class ScalarSector(Sector):
                          for fld in range(self.nscalars)],
         }
 
+    def energy_means(self, f, dfdt, a=1.0, lap_f=None):
+        """Traceable mean energy densities of the scalar system —
+        the model-level invariant inputs for the numerics sentinel
+        (:mod:`pystella_tpu.obs.sentinel`): ``kinetic`` and
+        ``potential`` (plus ``gradient`` when ``lap_f`` is supplied —
+        the reducers' integration-by-parts form) and their ``total``,
+        matching :attr:`reducers` up to the lattice average. Pure jnp,
+        so it runs inside a jitted step on sharded arrays with no host
+        sync; a drifting ``total`` in a conserved setting is the drift
+        slope the ledger's ``numerics`` section and the gate track.
+
+        :arg f, dfdt: field arrays ``(nscalars, ...)``.
+        :arg a: scale factor (scalar, traced or static).
+        :arg lap_f: optional Laplacian of ``f`` — omit it (driver loops
+            that don't already have one) and the gradient energy is
+            skipped rather than paid for with an extra stencil pass.
+        """
+        import jax.numpy as jnp
+
+        from pystella_tpu.field import evaluate
+
+        out = {"kinetic": jnp.mean(jnp.sum(dfdt * dfdt, axis=0))
+               / 2 / a**2}
+        if lap_f is not None:
+            out["gradient"] = (jnp.mean(jnp.sum(-f * lap_f, axis=0))
+                               / 2 / a**2)
+        pot = jnp.asarray(evaluate(self.potential(self.f), {"f": f}))
+        out["potential"] = jnp.mean(jnp.broadcast_to(pot, f.shape[1:]))
+        out["total"] = sum(out.values())
+        return out
+
     def stress_tensor(self, mu, nu, drop_trace=False):
         f = self.f
         a = Var("a")

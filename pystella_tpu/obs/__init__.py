@@ -43,6 +43,24 @@ of one wall-clock number:
   plus a contamination detector; exits nonzero on regression or invalid
   evidence so CI can consume it.
 
+The NUMERICS OBSERVABILITY layer (PR 4) makes the *physics* of a run as
+observable as its performance — always-on, with no host sync on the
+step critical path:
+
+- :mod:`pystella_tpu.obs.sentinel` — a compact per-step health vector
+  (per-field finite/max-abs/rms plus model invariants: energy
+  components, Friedmann-constraint residual) computed *inside* the
+  compiled step, consumed asynchronously by a
+  :class:`~pystella_tpu.obs.sentinel.SentinelMonitor` that only ever
+  blocks on vectors already ``every`` steps behind the driver.
+- :mod:`pystella_tpu.obs.forensics` — on a tripped sentinel, a
+  forensic bundle: last-K health vectors, per-field blowup curves, the
+  event-log tail, config/env fingerprint, and the last-good-checkpoint
+  pointer.
+- the ledger gains a ``numerics`` report section (invariant drift
+  slopes, sentinel overhead) and the gate fails CI on a
+  constraint-drift regression exactly like a step-time regression.
+
 See ``doc/observability.md`` for the event schema and driver recipes.
 """
 
@@ -51,7 +69,8 @@ from pystella_tpu.obs.events import (
 from pystella_tpu.obs.metrics import (
     Counter, Gauge, MetricsRegistry, Timer, counter, gauge, registry, timer)
 from pystella_tpu.obs.scope import (
-    has_scope, lowered_scopes, trace_scope, traced)
+    has_scope, lowered_scopes, register_scope, registered_scopes,
+    trace_scope, traced)
 from pystella_tpu.obs.memory import (
     CompileRecord, compile_with_report, device_memory_report,
     device_memory_stats)
@@ -59,18 +78,24 @@ from pystella_tpu.obs.memory import (
 # ``python -m pystella_tpu.obs.gate``, and runpy warns when the module
 # is already in sys.modules at -m execution time. Import it explicitly
 # (``from pystella_tpu.obs import gate``) for programmatic use.
-from pystella_tpu.obs import ledger, trace
+from pystella_tpu.obs import forensics, ledger, sentinel, trace
 from pystella_tpu.obs.ledger import PerfLedger, environment_fingerprint
 from pystella_tpu.obs.trace import scope_durations, summarize_trace
+from pystella_tpu.obs.sentinel import (
+    Sentinel, SentinelMonitor, SimulationDiverged)
+from pystella_tpu.obs.forensics import ForensicSink, load_bundle, write_bundle
 
 __all__ = [
     "EventLog", "configure", "emit", "get_log", "read_events",
     "Counter", "Gauge", "Timer", "MetricsRegistry",
     "counter", "gauge", "timer", "registry",
     "trace_scope", "traced", "lowered_scopes", "has_scope",
+    "register_scope", "registered_scopes",
     "CompileRecord", "compile_with_report",
     "device_memory_report", "device_memory_stats",
-    "trace", "ledger",
+    "trace", "ledger", "sentinel", "forensics",
     "PerfLedger", "environment_fingerprint",
     "scope_durations", "summarize_trace",
+    "Sentinel", "SentinelMonitor", "SimulationDiverged",
+    "ForensicSink", "load_bundle", "write_bundle",
 ]

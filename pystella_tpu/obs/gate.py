@@ -9,11 +9,16 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
 ====  ====================================================================
 0     pass (no regression beyond noise, evidence valid)
 1     regression: current median step time exceeds baseline by more than
-      the threshold AND more than ``mad_k`` robust sigmas of noise
+      the threshold AND more than ``mad_k`` robust sigmas of noise — or
+      a NUMERICS regression: a sentinel invariant's drift slope exceeds
+      ``drift_factor`` x the baseline's (constraint drift worse than
+      baseline fails CI the same way a slow step does)
 2     invalid evidence: the contamination detector flagged the run
       (outlier burst / bimodal step times — the round-5 concurrent-probe
-      signature), the report has no step samples, or baseline and
-      current were measured on different hardware
+      signature), the report has no step samples, the run DIVERGED (a
+      sentinel trip in the ``numerics`` section — broken step times
+      prove nothing), or baseline and current were measured on
+      different hardware
 3     missing or unreadable baseline (suppress with
       ``--allow-missing-baseline``, e.g. on a branch's first run)
 4     unreadable current report / bad usage
@@ -177,7 +182,8 @@ def _env_comparable(base_env, cur_env):
 def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     outlier_k=5.0, burst_limit=4, frac_limit=0.10,
                     allow_env_mismatch=False,
-                    check_contamination="auto"):
+                    check_contamination="auto", check_numerics=True,
+                    drift_factor=10.0, drift_floor=1e-12):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -189,6 +195,15 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     step times are tight unless someone else holds the chip (the
     round-5 scenario the detector exists for). ``"always"`` /
     ``"never"`` force it either way.
+
+    ``check_numerics`` (default on) extends the gate beyond step times:
+    a run whose ``numerics`` section records a sentinel trip is invalid
+    evidence (exit 2 — diverged step times prove nothing), and a
+    physics-invariant **drift slope** more than ``drift_factor`` times
+    the baseline's (each floored at ``drift_floor``/step so a ~zero
+    baseline slope cannot make any finite drift a regression) fails the
+    gate exactly like a perf regression (exit 1) — a silent numerics
+    regression fails CI the same way a slow step does.
     """
     verdict = {"ok": True, "exit_code": 0, "reasons": [],
                "warnings": []}
@@ -199,6 +214,21 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
         verdict.update(ok=False, exit_code=2)
         verdict["reasons"].append(
             "invalid_evidence: current report has no step samples")
+        return verdict
+
+    cur_num = current.get("numerics") or {}
+    if check_numerics and cur_num.get("diverged"):
+        # a diverged run's step times measure a broken computation;
+        # neither pass nor fail — and the reason points at the bundle
+        verdict.update(ok=False, exit_code=2)
+        for d in cur_num["diverged"]:
+            inv = d.get("offending_invariant")
+            verdict["reasons"].append(
+                "invalid_evidence: run diverged at step "
+                f"{d.get('step')} (fields {d.get('fields')}"
+                + (f", invariant {inv!r}" if inv else "") + ")")
+        for b in cur_num.get("forensic_bundles") or []:
+            verdict["reasons"].append(f"forensic bundle: {b}")
         return verdict
 
     run_detector = (check_contamination == "always"
@@ -290,7 +320,61 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
         verdict["warnings"].append(
             f"improvement: median step time {100 * rel:+.1f}% vs "
             "baseline — consider refreshing the baseline")
+
+    if check_numerics:
+        _compare_numerics(verdict, baseline, current,
+                          drift_factor=drift_factor,
+                          drift_floor=drift_floor)
     return verdict
+
+
+def _compare_numerics(verdict, baseline, current, drift_factor=10.0,
+                      drift_floor=1e-12):
+    """Invariant-drift comparison (mutates ``verdict`` in place): for
+    every invariant both reports tracked, the current |drift/step| must
+    stay within ``drift_factor`` x the baseline's (both floored at
+    ``drift_floor``). Invariants only one side tracked degrade to a
+    warning — losing numerics coverage should be visible, not fatal."""
+    bnum = (baseline.get("numerics") or {}).get("invariants") or {}
+    cnum = (current.get("numerics") or {}).get("invariants") or {}
+    if not bnum and not cnum:
+        return
+    if bnum and not cnum:
+        verdict["warnings"].append(
+            "numerics: baseline tracked invariants "
+            f"{sorted(bnum)} but the current run has no numerics "
+            "section — sentinel coverage was lost")
+        return
+    compared = {}
+    for name in sorted(set(bnum) & set(cnum)):
+        bn = bnum[name].get("n") or 0
+        cn = cnum[name].get("n") or 0
+        if bn < 2 or cn < 2:
+            # a degenerate series yields slope 0.0 (ledger._slope),
+            # indistinguishable from a genuinely flat invariant —
+            # gating against the bare floor would flag honest roundoff
+            verdict["warnings"].append(
+                f"numerics: invariant {name!r} has too few samples "
+                f"for a drift slope (baseline n={bn}, current "
+                f"n={cn}); not compared")
+            continue
+        b = abs(bnum[name].get("drift_per_step") or 0.0)
+        c = abs(cnum[name].get("drift_per_step") or 0.0)
+        allowed = drift_factor * max(b, drift_floor)
+        compared[name] = {"baseline_drift": b, "current_drift": c,
+                          "allowed": allowed}
+        if c > allowed:
+            verdict.update(ok=False, exit_code=max(
+                verdict["exit_code"], 1))
+            verdict["reasons"].append(
+                f"numerics regression: invariant {name!r} drift "
+                f"{c:.3e}/step vs baseline {b:.3e}/step (allowed "
+                f"factor {drift_factor:g}, floor {drift_floor:g})")
+    for name in sorted(set(bnum) - set(cnum)):
+        verdict["warnings"].append(
+            f"numerics: invariant {name!r} tracked in the baseline "
+            "but not the current run")
+    verdict["numerics"] = compared
 
 
 def main(argv=None):
@@ -324,6 +408,17 @@ def main(argv=None):
                         "on accelerator reports only (CPU step times "
                         "are legitimately scheduler-noisy; the median "
                         "comparison absorbs that); always/never force")
+    p.add_argument("--drift-factor", type=float, default=10.0,
+                   help="numerics: allowed multiple of the baseline's "
+                        "invariant drift slope before the gate fails "
+                        "(default 10)")
+    p.add_argument("--drift-floor", type=float, default=1e-12,
+                   help="numerics: drift-per-step floor applied to both "
+                        "sides, so a ~zero baseline slope cannot make "
+                        "any finite drift a regression (default 1e-12)")
+    p.add_argument("--no-numerics", action="store_true",
+                   help="skip the numerics checks (invariant drift, "
+                        "diverged-run invalidation)")
     p.add_argument("--allow-missing-baseline", action="store_true",
                    help="exit 0 (after the contamination check) when "
                         "the baseline file does not exist")
@@ -355,7 +450,9 @@ def main(argv=None):
         mad_k=args.mad_k, outlier_k=args.outlier_k,
         burst_limit=args.burst, frac_limit=args.outlier_frac,
         allow_env_mismatch=args.allow_env_mismatch,
-        check_contamination=args.check_contamination)
+        check_contamination=args.check_contamination,
+        check_numerics=not args.no_numerics,
+        drift_factor=args.drift_factor, drift_floor=args.drift_floor)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

@@ -209,6 +209,10 @@ class PerfLedger:
         self.compile_records = []       # compile-event payloads
         self.metrics = {}               # registry snapshot
         self.meta = {}                  # run-metadata event payload
+        self.health_series = {}         # invariant name -> [(step, value)]
+        self.health_events = 0          # health events ingested
+        self.diverged = []              # sentinel trips (step, fields)
+        self.forensic_bundles = []      # bundle paths written this run
 
     # -- ingestion ---------------------------------------------------------
 
@@ -263,6 +267,21 @@ class PerfLedger:
                 led.halo_bytes_per_step = float(data["bytes_per_step"])
             elif kind == "compile":
                 led.compile_records.append(data)
+            elif kind == "health":
+                # sentinel health vectors (obs.sentinel): the invariant
+                # scalars become the numerics section's drift series
+                led.health_events += 1
+                for name, val in (data.get("invariants") or {}).items():
+                    if isinstance(val, (int, float)):
+                        led.health_series.setdefault(name, []).append(
+                            (ev.get("step"), float(val)))
+            elif kind == "diverged":
+                led.diverged.append({"step": ev.get("step"),
+                                     "fields": data.get("fields"),
+                                     "offending_invariant":
+                                         data.get("offending_invariant")})
+            elif kind == "forensic_bundle":
+                led.forensic_bundles.append(data.get("path"))
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -385,6 +404,48 @@ class PerfLedger:
             "achieved_ici_gbps": ici,
         }
 
+    def numerics(self):
+        """The numerics-observability summary (sentinel health): per
+        invariant the first/last values and the least-squares
+        **drift slope per step** (the quantity the gate compares — a
+        silent physics regression shows up as a steeper slope), plus
+        health-event counts, the sentinel's host-side overhead as a
+        percentage of step time (from the ``sentinel`` and ``step``
+        metrics timers), any sentinel trips, and forensic-bundle
+        pointers. ``None`` when the run carried no numerics telemetry
+        at all."""
+        invariants = {}
+        for name, series in self.health_series.items():
+            vals = [v for _, v in series]
+            steps = [s if isinstance(s, (int, float)) else i
+                     for i, (s, _) in enumerate(series)]
+            invariants[name] = {
+                "n": len(vals),
+                "first": vals[0],
+                "last": vals[-1],
+                "min": min(vals),
+                "max": max(vals),
+                "drift_per_step": _slope(steps, vals),
+            }
+        overhead = None
+        step_s = self.metrics.get("step.total_s")
+        sent_s = self.metrics.get("sentinel.total_s")
+        if isinstance(step_s, (int, float)) and step_s > 0 \
+                and isinstance(sent_s, (int, float)):
+            overhead = 100.0 * sent_s / step_s
+        checks = self.metrics.get("health_checks")
+        if not (invariants or self.health_events or self.diverged
+                or checks):
+            return None
+        return {
+            "invariants": invariants,
+            "health_events": self.health_events,
+            "health_checks": checks,
+            "sentinel_overhead_pct": overhead,
+            "diverged": self.diverged,
+            "forensic_bundles": self.forensic_bundles,
+        }
+
     # -- report ------------------------------------------------------------
 
     def report(self):
@@ -405,6 +466,7 @@ class PerfLedger:
             },
             "roofline": self.roofline(),
             "overlap": self.overlap_summary(),
+            "numerics": self.numerics(),
             "scopes": self.scopes,
             "trace_file": self.trace_file,
             "metrics": self.metrics,
@@ -425,6 +487,20 @@ class PerfLedger:
             f.write(render_markdown(rep))
         _events.emit("perf_report", path=json_path, label=self.label)
         return json_path
+
+
+def _slope(xs, ys):
+    """Least-squares slope of ``ys`` against ``xs`` (0.0 for degenerate
+    inputs) — the invariant-drift-per-step statistic."""
+    n = len(xs)
+    if n < 2:
+        return 0.0
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    var = sum((x - mx) ** 2 for x in xs)
+    if var == 0:
+        return 0.0
+    return sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / var
 
 
 def _fmt(x, spec=".4g", none="—"):
@@ -497,6 +573,30 @@ def render_markdown(rep):
                 f"overlapped call(s) -> achieved "
                 f"~{_fmt(ov.get('achieved_ici_gbps'))} GB/s ICI "
                 "(per-device estimate)")
+        lines.append("")
+    nm = rep.get("numerics")
+    if nm:
+        lines += ["## Numerics health", ""]
+        for name, row in sorted((nm.get("invariants") or {}).items()):
+            lines.append(
+                f"- invariant `{name}`: {_fmt(row.get('first'), '.6g')} "
+                f"-> {_fmt(row.get('last'), '.6g')} over "
+                f"{row.get('n')} sample(s), drift "
+                f"{_fmt(row.get('drift_per_step'), '.3e')}/step")
+        lines.append(
+            f"- {_fmt(nm.get('health_checks'), '.0f', '0')} health "
+            f"check(s), sentinel overhead "
+            f"{_fmt(nm.get('sentinel_overhead_pct'), '.2f')}% of step "
+            "time (host-side; the in-graph reductions are inside the "
+            "step samples themselves)")
+        for d in nm.get("diverged") or []:
+            lines.append(
+                f"- **DIVERGED** at step {d.get('step')}: "
+                f"{d.get('fields')}"
+                + (f" (invariant `{d['offending_invariant']}`)"
+                   if d.get("offending_invariant") else ""))
+        for b in nm.get("forensic_bundles") or []:
+            lines.append(f"- forensic bundle: `{b}`")
         lines.append("")
     lines += [
         "## Per-scope breakdown",

@@ -1,4 +1,4 @@
-"""Named trace scopes for hot paths.
+"""Named trace scopes for hot paths, plus the central scope registry.
 
 One context manager, two sinks:
 
@@ -18,6 +18,21 @@ The scope names survive into the lowered MLIR's debug locations, which
 is how tests verify instrumentation without capturing a real trace:
 :func:`lowered_scopes` / :func:`has_scope` parse them back out of a
 ``jax.jit(...).lower(...)`` result.
+
+**Registry.** Every scope name the package emits must be registered here
+(:func:`register_scope`): :data:`pystella_tpu.obs.trace.KNOWN_SCOPES` —
+the vocabulary the Perfetto parser folds trace rows into, and therefore
+everything the ledger's per-scope tables can ever show — is derived from
+:func:`registered_scopes`. A tier-1 test
+(``tests/test_scope_registry.py``) greps every ``trace_scope(...)`` /
+``named_scope(...)`` literal in ``pystella_tpu/`` against the registry,
+so a renamed hot-path scope can no longer silently vanish from
+trace/ledger tables: the rename either updates the registry (and the
+parser vocabulary with it) or fails CI.
+
+jax is imported lazily inside the functions (not at module import), so
+this module stays loadable by file in a jax-free supervisor, like
+``obs/events.py``.
 """
 
 from __future__ import annotations
@@ -26,9 +41,53 @@ import contextlib
 import functools
 import re
 
-import jax
+__all__ = ["trace_scope", "traced", "lowered_scopes", "has_scope",
+           "register_scope", "registered_scopes"]
 
-__all__ = ["trace_scope", "traced", "lowered_scopes", "has_scope"]
+
+#: the central scope-name registry (see module docstring); seeded below
+#: with the in-tree instrumentation vocabulary
+_SCOPE_REGISTRY = set()
+
+
+def register_scope(name):
+    """Register a scope name (idempotent; returns ``name``). Call this
+    for any new ``trace_scope``/``named_scope`` literal so the Perfetto
+    parser (:data:`pystella_tpu.obs.trace.KNOWN_SCOPES`) and the
+    ledger's per-scope tables know about it — the tier-1 registry test
+    fails on unregistered literals."""
+    _SCOPE_REGISTRY.add(str(name))
+    return name
+
+
+def registered_scopes():
+    """The registered scope names, as a frozenset."""
+    return frozenset(_SCOPE_REGISTRY)
+
+
+for _name in (
+    # generic stepper stages (rk_stage0..N fold into this at parse time)
+    "rk_stage",
+    # fused Pallas steppers
+    "fused_rk_stage", "fused_rk_stage_pair", "fused_rk_stage_energy",
+    "fused_coupled_pair",
+    # halo exchange: padded path and the overlapped interior/shell split
+    "halo_exchange",
+    "halo_overlap", "halo_overlap_interior", "halo_overlap_shells",
+    # the raw XLA ppermute op rows — device traces carry them with no
+    # named-scope path; the ledger's communication-time denominator
+    "collective-permute",
+    # Pallas kernel dispatch
+    "pallas_stencil", "pallas_resident_stencil",
+    # multigrid
+    "mg_cycle", "mg_smooth", "mg_residual",
+    # driver-level spans (bench smoke / example loops)
+    "bench_step", "driver_step",
+    # the in-graph numerics health vector (obs.sentinel)
+    "sentinel",
+):
+    register_scope(_name)
+del _name
 
 
 @contextlib.contextmanager
@@ -36,6 +95,7 @@ def trace_scope(name):
     """Name everything inside for both compiled-code traces
     (``jax.named_scope``) and the host timeline
     (``jax.profiler.TraceAnnotation``)."""
+    import jax
     with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
         yield
 

@@ -40,29 +40,30 @@ import os
 import re
 
 from pystella_tpu.obs import events as _events
+from pystella_tpu.obs.scope import registered_scopes as _registered
 
 __all__ = ["KNOWN_SCOPES", "capture", "find_trace_file",
            "parse_trace_file", "scope_durations", "summarize_trace"]
 
-#: the PR-1 instrumentation vocabulary (doc/observability.md "Trace
-#: scopes") plus the driver-level spans the bench/smoke loops add.
-#: ``halo_overlap*`` are the overlapped-halo-path phases (whole
-#: overlapped update / interior-while-collectives-fly / shell
-#: stitching); ``collective-permute`` matches the RAW XLA ppermute op
-#: rows, which appear in device traces (TPU and the TFRT CPU backend)
-#: without any named-scope path — the comm-time denominator for the
-#: ledger's exposed-vs-hidden breakdown.
-KNOWN_SCOPES = (
-    "rk_stage",
-    "fused_rk_stage", "fused_rk_stage_pair", "fused_rk_stage_energy",
-    "fused_coupled_pair",
-    "halo_exchange",
-    "halo_overlap", "halo_overlap_interior", "halo_overlap_shells",
-    "collective-permute",
-    "pallas_stencil", "pallas_resident_stencil",
-    "mg_cycle", "mg_smooth", "mg_residual",
-    "bench_step", "driver_step",
-)
+# The instrumentation vocabulary (doc/observability.md "Trace
+# scopes") is the central registry in :mod:`pystella_tpu.obs.scope`:
+# ``KNOWN_SCOPES`` (served via module ``__getattr__`` below) and every
+# ``scopes=None`` default in this module resolve the registry AT CALL
+# TIME, so ``register_scope()`` after import is sufficient for traces
+# and ledger tables to pick a scope up (and an unregistered literal
+# fails ``tests/test_scope_registry.py``). Notable members:
+# ``halo_overlap*`` are the overlapped-halo-path phases (whole
+# overlapped update / interior-while-collectives-fly / shell
+# stitching); ``collective-permute`` matches the RAW XLA ppermute op
+# rows, which appear in device traces (TPU and the TFRT CPU backend)
+# without any named-scope path — the comm-time denominator for the
+# ledger's exposed-vs-hidden breakdown.
+
+
+def __getattr__(name):
+    if name == "KNOWN_SCOPES":
+        return tuple(sorted(_registered()))
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def _scope_matchers(scopes):
@@ -105,12 +106,14 @@ def parse_trace_file(path):
     return evs if isinstance(evs, list) else []
 
 
-def scope_durations(trace_events, scopes=KNOWN_SCOPES):
+def scope_durations(trace_events, scopes=None):
     """Fold complete-span events (``ph == "X"``, microsecond ``dur``)
     into ``{scope: {"count", "total_ms", "mean_ms", "min_ms",
-    "max_ms"}}`` for every known scope that appears. Each event counts
-    toward the longest matching scope only."""
-    matchers = _scope_matchers(scopes)
+    "max_ms"}}`` for every known scope that appears (default: the live
+    scope registry). Each event counts toward the longest matching
+    scope only."""
+    matchers = _scope_matchers(_registered() if scopes is None
+                               else scopes)
     acc = {}
     for ev in trace_events:
         if not isinstance(ev, dict) or ev.get("ph") != "X":
@@ -133,7 +136,7 @@ def scope_durations(trace_events, scopes=KNOWN_SCOPES):
             for scope, (n, tot, lo, hi) in sorted(acc.items())}
 
 
-def summarize_trace(logdir, scopes=KNOWN_SCOPES, label="", step=None,
+def summarize_trace(logdir, scopes=None, label="", step=None,
                     log=None):
     """Parse the newest trace under ``logdir`` into a per-scope duration
     table and emit it as one ``kind="trace_summary"`` run event
@@ -170,7 +173,7 @@ class capture:
     :class:`pystella_tpu.obs.ledger.PerfLedger` picks them up.
     """
 
-    def __init__(self, logdir, scopes=KNOWN_SCOPES, label="", step=None,
+    def __init__(self, logdir, scopes=None, label="", step=None,
                  log=None):
         self.logdir = str(logdir)
         self.scopes = scopes

@@ -661,7 +661,7 @@ class FusedScalarStepper(_step.Stepper):
         return self.extract(carry)
 
     def multi_step(self, state, nsteps, t=0.0, dt=None, rhs_args=None,
-                   rhs_seq=None):
+                   rhs_seq=None, sentinel=None):
         """Advance ``nsteps`` full RK steps as one jitted computation,
         pairing stages ACROSS step boundaries. For RK54's odd stage count
         this eliminates the single-stage kernel entirely: 10 stages per
@@ -681,7 +681,14 @@ class FusedScalarStepper(_step.Stepper):
 
         The input ``state`` buffers are DONATED (this is the hot-loop
         driver; donation keeps peak HBM at one state + one carry) — do
-        not reuse ``state`` after the call."""
+        not reuse ``state`` after the call.
+
+        With ``sentinel`` (a :class:`~pystella_tpu.obs.sentinel.
+        Sentinel`), the chunk additionally computes the health vector of
+        its FINAL state inside the same jitted computation (the
+        sentinel's reductions piggyback on the chunk — no extra
+        dispatch, no host sync) and returns ``(state, health_vector)``
+        for asynchronous polling by a ``SentinelMonitor``."""
         dt = dt if dt is not None else self.dt
         nsteps = int(nsteps)
         if rhs_seq:
@@ -693,13 +700,24 @@ class FusedScalarStepper(_step.Stepper):
                         f"rhs_seq[{n!r}] has {v.shape[0]} entries; need "
                         f"one per stage ({nsteps} steps x "
                         f"{self.num_stages} stages = {nflat})")
-        key = (nsteps, tuple(sorted(rhs_seq)) if rhs_seq else None)
+        key = (nsteps, tuple(sorted(rhs_seq)) if rhs_seq else None,
+               None if sentinel is None else id(sentinel))
         fn = self._jit_multi.get(key)
         if fn is None:
             import functools
             import jax
-            fn = jax.jit(functools.partial(
-                self._multi_step_impl, nsteps=nsteps), donate_argnums=0)
+            impl = functools.partial(self._multi_step_impl,
+                                     nsteps=nsteps)
+            if sentinel is not None:
+                base_impl = impl
+
+                def impl(state, t, dt, rhs_args, rhs_seq):
+                    new = base_impl(state, t=t, dt=dt,
+                                    rhs_args=rhs_args, rhs_seq=rhs_seq)
+                    with trace_scope("sentinel"):
+                        hv = sentinel.compute(new)
+                    return new, hv
+            fn = jax.jit(impl, donate_argnums=0)
             self._jit_multi[key] = fn
         _metrics.counter("steps").inc(nsteps)
         return fn(state, t=t, dt=dt, rhs_args=rhs_args or {},
@@ -1018,7 +1036,8 @@ class FusedScalarStepper(_step.Stepper):
         return self.extract(carry), a, adot
 
     def coupled_multi_step(self, state, nsteps, expansion, t=0.0,
-                           dt=None, grid_size=None, pair=None):
+                           dt=None, grid_size=None, pair=None,
+                           sentinel=None):
         """Advance ``nsteps`` steps as ONE jitted computation with the
         scale factor evolved self-consistently on device — the accurate
         fast path for expanding-background runs (``--chunk-steps`` with
@@ -1038,7 +1057,14 @@ class FusedScalarStepper(_step.Stepper):
         a ``hubble``-referencing potential, or no feasible blocking).
         ``expansion`` (an :class:`~pystella_tpu.Expansion`) provides the
         entry ``(a, adot)`` and is ADVANCED to the chunk end. The input
-        ``state`` buffers are donated."""
+        ``state`` buffers are donated.
+
+        With ``sentinel``, the chunk also computes the health vector of
+        its final state in the same computation, with the chunk-end
+        ``(a, adot)`` passed as the sentinel ``aux`` — so invariants
+        like :meth:`~pystella_tpu.Expansion.constraint_residual` see
+        the exact on-device background. Returns ``(state,
+        health_vector)`` instead of ``state``."""
         import functools
         import jax
         dt = dt if dt is not None else self.dt
@@ -1055,22 +1081,34 @@ class FusedScalarStepper(_step.Stepper):
                 "A[0] != 0, a hubble-referencing potential, or no "
                 "feasible blocking)")
         self._ensure_energy_call()  # pair path's odd-tail stage uses it
-        key = (nsteps, grid_size, mpl, bool(pair))
+        key = (nsteps, grid_size, mpl, bool(pair),
+               None if sentinel is None else id(sentinel))
         fn = self._jit_coupled.get(key)
         if fn is None:
             impl = self._coupled_pair_impl if pair else self._coupled_impl
-            fn = jax.jit(functools.partial(
-                impl, nsteps=nsteps, grid_size=grid_size,
-                mpl=mpl), donate_argnums=0)
+            impl = functools.partial(impl, nsteps=nsteps,
+                                     grid_size=grid_size, mpl=mpl)
+            if sentinel is not None:
+                base_impl = impl
+
+                def impl(state, t, dt, a, adot):
+                    new, a2, adot2 = base_impl(state, t=t, dt=dt, a=a,
+                                               adot=adot)
+                    with trace_scope("sentinel"):
+                        hv = sentinel.compute(new, {"a": a2,
+                                                    "adot": adot2})
+                    return new, a2, adot2, hv
+            fn = jax.jit(impl, donate_argnums=0)
             self._jit_coupled[key] = fn
         _metrics.counter("steps").inc(nsteps)
-        state, a, adot = fn(state, t=t, dt=dt,
-                            a=jnp.asarray(float(expansion.a)),
-                            adot=jnp.asarray(float(expansion.adot)))
+        res = fn(state, t=t, dt=dt,
+                 a=jnp.asarray(float(expansion.a)),
+                 adot=jnp.asarray(float(expansion.adot)))
+        state, a, adot = res[:3]
         expansion.a = expansion.dtype.type(np.asarray(a))
         expansion.adot = expansion.dtype.type(np.asarray(adot))
         expansion.hubble = expansion.adot / expansion.a
-        return state
+        return state if sentinel is None else (state, res[3])
 
 
 class FusedPreheatStepper(FusedScalarStepper):

@@ -121,6 +121,9 @@ class Stepper:
                     carry = self.stage(s, carry, t, dt, rhs_args)
             return self.extract(carry)
 
+        # kept for step_with_health, which re-traces the same step body
+        # with the sentinel's reductions appended
+        self._step_impl = _step_impl
         # one fused XLA computation per (state structure, rhs_args
         # structure). ``donate=True`` donates the input state buffers to
         # the step (the caller must not reuse the old state), letting XLA
@@ -160,6 +163,32 @@ class Stepper:
         jit-compiled computation."""
         dt = dt if dt is not None else self.dt
         return self._jit_step(state, t, dt, rhs_args or {})
+
+    def step_with_health(self, state, sentinel, t=0.0, dt=None,
+                         rhs_args=None, aux=None):
+        """Like :meth:`step`, additionally returning ``sentinel``'s
+        health vector of the NEW state — computed in the SAME jitted
+        computation, so the sentinel's ``isfinite``/max-abs/rms
+        reductions fuse with the step's final writes: in-graph numerics
+        observability with no extra dispatch and no host sync
+        (:mod:`pystella_tpu.obs.sentinel`). The caller hands the tiny
+        returned vector to ``SentinelMonitor.push`` and polls it
+        asynchronously. ``aux`` (a dict of scalars, e.g. the expansion
+        background) is forwarded to the sentinel's invariants. Returns
+        ``(new_state, health_vector)``."""
+        dt = dt if dt is not None else self.dt
+        cache = self.__dict__.setdefault("_jit_health_step", {})
+        fn = cache.get(id(sentinel))
+        if fn is None:
+            def impl(state, t, dt, rhs_args, aux):
+                new = self._step_impl(state, t, dt, rhs_args)
+                with trace_scope("sentinel"):
+                    hv = sentinel.compute(new, aux)
+                return new, hv
+            fn = jax.jit(impl, donate_argnums=(
+                (0,) if getattr(self, "_donate", False) else ()))
+            cache[id(sentinel)] = fn
+        return fn(state, t, dt, rhs_args or {}, aux or {})
 
     # -- per-stage interface (reference-style driver loops) ----------------
 

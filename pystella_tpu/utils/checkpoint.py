@@ -84,6 +84,20 @@ class Checkpointer:
     def latest_step(self):
         return self._mngr.latest_step()
 
+    @property
+    def last_good(self):
+        """Pointer to the newest checkpoint, as a JSON-safe
+        ``{"directory", "step"}`` dict (``None`` when nothing is saved
+        yet) — the resume-from-here record a forensic bundle embeds on
+        divergence (:mod:`pystella_tpu.obs.forensics`). "Good" holds by
+        construction: the drivers health-check the state (synchronously)
+        immediately before every save, so a diverged state is never
+        checkpointed."""
+        step = self.latest_step
+        if step is None:
+            return None
+        return {"directory": self.directory, "step": int(step)}
+
     def all_steps(self):
         return sorted(self._mngr.all_steps())
 

@@ -2,88 +2,123 @@
 
 The reference has no failure detection — an instability silently corrupts
 the run until MPI aborts (/root/repo/SURVEY.md section 5, "Failure
-detection: absent"). Here drivers can wrap their loop with a
-:class:`HealthMonitor` that checks the state every N steps (one cheap
-device-side reduction per field, amortized) and raises
-:class:`SimulationDiverged` with the offending field names, so a
-checkpointed run can stop early and resume from the last good snapshot.
+detection: absent"). Here drivers wrap their loop with a
+:class:`HealthMonitor` built on the in-graph numerics sentinel
+(:mod:`pystella_tpu.obs.sentinel`): a compact per-step health vector
+(per-field finite/max-abs/rms) computed as one tiny fused dispatch and
+polled **asynchronously** — the host only ever converts vectors already
+``every`` steps behind the driver, so the check adds no sync to the
+step critical path. On failure :class:`SimulationDiverged` is raised
+with the offending field names and the *actual* offending step, after
+the configured :class:`~pystella_tpu.obs.forensics.ForensicSink` (if
+any) wrote its bundle — so a checkpointed run can stop early, diagnose,
+and resume from the last good snapshot.
+
+Two usage modes:
+
+- **async (preferred)** — once per step/chunk call
+  :meth:`HealthMonitor.observe` then :meth:`~HealthMonitor.poll`; call
+  :meth:`~HealthMonitor.flush` at loop exit and
+  :meth:`~HealthMonitor.check_now` (synchronous) immediately before
+  trusting the state, e.g. a checkpoint save.
+- **sync (legacy)** — the original ``monitor(step, state)`` contract:
+  a blocking check every ``every`` steps.
 """
 
 from __future__ import annotations
 
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-
-from pystella_tpu.obs import events as _events
-from pystella_tpu.obs import metrics as _metrics
+from pystella_tpu.obs import sentinel as _sentinel
+from pystella_tpu.obs.sentinel import (  # noqa: F401  (re-exports)
+    Sentinel, SentinelMonitor, SimulationDiverged)
 
 __all__ = ["HealthMonitor", "SimulationDiverged"]
 
 
-class SimulationDiverged(RuntimeError):
-    """Raised when non-finite values appear in the simulation state."""
-
-    def __init__(self, step, bad_fields):
-        self.step = step
-        self.bad_fields = tuple(bad_fields)
-        super().__init__(
-            f"non-finite values at step {step} in fields: "
-            f"{', '.join(self.bad_fields)}")
-
-
 class HealthMonitor:
-    """Periodic finite-ness check over a state pytree.
+    """Finite-ness (and optional magnitude-bound) watchdog over a state
+    pytree, async-first.
 
-    :arg every: check interval in steps (checks are one ``isfinite`` +
-        ``all`` reduction per array; keep modest to amortize).
-    :arg max_abs: optional magnitude bound — exceeding it also counts as
-        divergence (useful to catch blowup before the first inf).
+    :arg every: async mode: the poll lag in steps (a vector is only
+        host-converted once the driver has pushed ``every`` newer
+        steps). Sync mode: the check interval.
+    :arg max_abs: optional magnitude bound — exceeding it also counts
+        as divergence (useful to catch blowup before the first inf).
+    :arg history: health vectors retained for the forensic bundle.
+
+    Set :attr:`forensics` to a
+    :class:`~pystella_tpu.obs.forensics.ForensicSink` to get a bundle
+    written on every trip.
     """
 
-    def __init__(self, every=50, max_abs=None):
+    def __init__(self, every=50, max_abs=None, history=64):
         self.every = int(every)
         self.max_abs = max_abs
+        self.history_size = int(history)
+        #: optional ForensicSink consulted on a trip
+        self.forensics = None
+        self._mon = None
+        self._names = None
 
-        max_abs_ = max_abs
+    def _monitor_for(self, state):
+        """The underlying :class:`SentinelMonitor`, rebuilt if the state
+        structure changed (pending vectors of the old structure are
+        flushed first so nothing silently escapes checking)."""
+        names = tuple(sorted(_sentinel.named_leaves(state)))
+        if self._mon is None or names != self._names:
+            if self._mon is not None:
+                self._mon.flush()
+            self._mon = _sentinel.SentinelMonitor(
+                _sentinel.Sentinel(names), every=self.every,
+                history=self.history_size, max_abs=self.max_abs)
+            self._names = names
+        self._mon.forensics = self.forensics
+        return self._mon
 
-        @jax.jit
-        def check(state):
-            def ok(x):
-                good = jnp.all(jnp.isfinite(x))
-                if max_abs_ is not None:
-                    good = good & (jnp.max(jnp.abs(x)) <= max_abs_)
-                return good
-            return jax.tree_util.tree_map(ok, state)
+    # -- async interface ---------------------------------------------------
 
-        self._check = check
+    def observe(self, step, state):
+        """Dispatch the health vector of ``state`` at ``step`` (one tiny
+        fused reduction, NO host sync) and enqueue it for a later
+        :meth:`poll`."""
+        self._monitor_for(state).observe(step, state)
 
-    def check_now(self, state):
-        """Run the health check unconditionally (e.g. immediately before a
-        checkpoint save); raises :class:`SimulationDiverged` on failure."""
-        return self.__call__(0, state)
+    def poll(self):
+        """Check every pending vector at least ``every`` steps behind
+        the newest :meth:`observe`; raises :class:`SimulationDiverged`
+        on failure. Returns the number of vectors checked."""
+        return 0 if self._mon is None else self._mon.poll()
+
+    def flush(self):
+        """Drain the pending queue unconditionally (loop exit)."""
+        return 0 if self._mon is None else self._mon.flush()
+
+    @property
+    def checked_through(self):
+        """Highest step actually health-checked so far (None before the
+        first check) — the driver runs ahead of this by >= ``every``."""
+        return None if self._mon is None else self._mon.checked_through
+
+    @property
+    def history(self):
+        """Decoded health vectors, newest last (the forensic last-K)."""
+        return [] if self._mon is None else list(self._mon.history)
+
+    # -- sync interface ----------------------------------------------------
+
+    def check_now(self, state, step=None):
+        """Run the health check synchronously (e.g. immediately before a
+        checkpoint save); raises :class:`SimulationDiverged` on failure.
+        Pass ``step`` so a trip (and its ``diverged`` event / forensic
+        bundle) reports the actual simulation step, not 0."""
+        self._monitor_for(state).check_sync(
+            0 if step is None else int(step), state)
+        return True
 
     def __call__(self, step, state):
-        """Check (every ``self.every`` steps); raises
+        """Check (every ``self.every`` steps, synchronously); raises
         :class:`SimulationDiverged` on failure, else returns True if the
-        check ran."""
+        check ran — the legacy blocking contract."""
         if step % self.every:
             return False
-        flags = self._check(state)
-        leaves = jax.tree_util.tree_flatten_with_path(flags)[0]
-
-        def name(path):
-            return ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                            for k in path)
-
-        bad = [name(path) for path, v in leaves
-               if not bool(np.asarray(v))]
-        _metrics.counter("health_checks").inc()
-        if bad:
-            # the forensic record a checkpointed run resumes from: which
-            # fields went non-finite, and exactly when
-            _events.emit("diverged", step=step, fields=bad,
-                         max_abs=self.max_abs)
-            raise SimulationDiverged(step, bad)
+        self._monitor_for(state).check_sync(step, state)
         return True

@@ -265,6 +265,78 @@ def test_gate_env_mismatch_is_invalid():
     assert any("env mismatch" in w for w in verdict["warnings"])
 
 
+def _with_numerics(rep, drift, name="constraint", n=50):
+    rep = dict(rep)
+    rep["numerics"] = {
+        "invariants": {name: {"n": n, "first": 1e-8,
+                              "last": 1e-8 + n * drift,
+                              "drift_per_step": drift}},
+        "health_events": n, "diverged": [], "forensic_bundles": []}
+    return rep
+
+
+def test_gate_numerics_drift_regression():
+    """The tentpole acceptance: a constraint-drift regression fails the
+    gate (exit 1) exactly like a step-time regression — and names the
+    offending invariant."""
+    base = _with_numerics(_report(_steady()), 1e-10)
+    cur = _with_numerics(_report(_steady(seed=9)), 5e-7)
+    verdict = gate.compare_reports(base, cur)
+    assert not verdict["ok"] and verdict["exit_code"] == 1
+    assert any("numerics regression" in r and "'constraint'" in r
+               for r in verdict["reasons"])
+    assert verdict["numerics"]["constraint"]["current_drift"] == 5e-7
+    # same drift: pass; modest growth within the factor: pass
+    assert gate.compare_reports(base, _with_numerics(
+        _report(_steady(seed=9)), 2e-10))["exit_code"] == 0
+    # numerics checks can be disabled
+    assert gate.compare_reports(base, cur,
+                                check_numerics=False)["exit_code"] == 0
+    # a ~zero baseline slope cannot flag drift under the floor
+    z = gate.compare_reports(_with_numerics(_report(_steady()), 0.0),
+                             _with_numerics(_report(_steady(seed=9)),
+                                            5e-12))
+    assert z["exit_code"] == 0
+
+
+def test_gate_numerics_skips_degenerate_series():
+    """A baseline invariant with <2 samples has no usable slope (the
+    ledger's least-squares degenerates to 0.0) — the gate must warn
+    and skip, not flag honest roundoff against the bare floor."""
+    base = _with_numerics(_report(_steady()), 0.0, n=1)
+    cur = _with_numerics(_report(_steady(seed=9)), 1e-9)
+    verdict = gate.compare_reports(base, cur)
+    assert verdict["exit_code"] == 0
+    assert any("too few samples" in w for w in verdict["warnings"])
+    assert "constraint" not in verdict["numerics"]
+
+
+def test_gate_numerics_coverage_loss_warns():
+    base = _with_numerics(_report(_steady()), 1e-10)
+    verdict = gate.compare_reports(base, _report(_steady(seed=9)))
+    assert verdict["exit_code"] == 0
+    assert any("sentinel coverage was lost" in w
+               for w in verdict["warnings"])
+
+
+def test_gate_diverged_run_is_invalid_evidence():
+    """A sentinel trip invalidates the run: broken step times prove
+    nothing in either direction — and the verdict points at the
+    forensic bundle."""
+    cur = _report(_steady())
+    cur["numerics"] = {"invariants": {}, "health_events": 3,
+                       "diverged": [{"step": 33, "fields": ["dfdt"],
+                                     "offending_invariant": None}],
+                       "forensic_bundles": ["/x/bundle.json"]}
+    verdict = gate.compare_reports(_report(_steady(seed=1)), cur)
+    assert verdict["exit_code"] == 2
+    assert any("diverged at step 33" in r for r in verdict["reasons"])
+    assert any("bundle" in r for r in verdict["reasons"])
+    # --no-numerics downgrades it back to a plain perf comparison
+    assert gate.compare_reports(_report(_steady(seed=1)), cur,
+                                check_numerics=False)["exit_code"] == 0
+
+
 def test_gate_cli_exit_codes(tmp_path):
     """main() drives argparse -> comparison -> exit code, including the
     missing-baseline paths."""
@@ -290,7 +362,7 @@ def test_gate_cli_exit_codes(tmp_path):
 
 # -- smoke -> gate end to end ---------------------------------------------
 
-def test_smoke_to_gate_end_to_end(tmp_path):
+def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     """Tier-1 pipeline integrity: ``bench.py --smoke`` writes a real
     perf_report.json (per-scope breakdown, throughput, environment
     fingerprint), and ``python -m pystella_tpu.obs.gate`` consumes it —
@@ -325,11 +397,20 @@ def test_smoke_to_gate_end_to_end(tmp_path):
     assert rep["env"].get("xla_flags") is not None
     md = open(os.path.join(out, "perf_report.md")).read()
     assert "Communication overlap" in md and "exposed" in md
+    # the numerics sentinel ran end to end: per-step health events,
+    # an invariant drift series, no trips, bounded overhead telemetry
+    nm = rep["numerics"]
+    assert nm["invariants"]["kinetic_mean"]["n"] == 12
+    assert np.isfinite(nm["invariants"]["kinetic_mean"]["drift_per_step"])
+    assert nm["diverged"] == []
+    assert nm["health_checks"] == 12
+    assert nm["sentinel_overhead_pct"] is not None
+    assert "Numerics health" in md
     # the event log behind it holds the full pipeline record
     kinds = {r["kind"] for r in events.read_events(
         os.path.join(out, "smoke_events.jsonl"))}
     assert {"bench_run", "compile", "step_time", "trace_summary",
-            "perf_report"} <= kinds
+            "perf_report", "health"} <= kinds
 
     def run_gate(*args):
         return subprocess.run(
@@ -364,3 +445,23 @@ def test_smoke_to_gate_end_to_end(tmp_path):
                    "--check-contamination", "always")
     assert res.returncode == 2, (res.stdout, res.stderr[-2000:])
     assert "invalid_evidence" in res.stdout
+
+    # synthetic constraint-drift regression: same step times, but the
+    # tracked invariant's drift slope blown up 1000x -> the NUMERICS
+    # gate exits nonzero and names the invariant. Driven through
+    # gate.main() in-process — the same argparse -> verdict -> exit
+    # path as the subprocess runs above, without another interpreter
+    # + jax startup against the tier-1 budget.
+    drift = dict(rep)
+    drift["numerics"] = json.loads(json.dumps(rep["numerics"]))
+    inv = drift["numerics"]["invariants"]["kinetic_mean"]
+    inv["drift_per_step"] = 1000.0 * (
+        abs(inv["drift_per_step"]) or 1e-6)
+    drift_path = str(tmp_path / "drift.json")
+    json.dump(drift, open(drift_path, "w"))
+    assert gate.main(["--baseline", report_path,
+                      "--current", drift_path]) == 1
+    capsys.readouterr()  # swallow the verdict prints
+    verdict = gate.compare_reports(rep, drift)
+    assert any("numerics regression" in r and "kinetic_mean" in r
+               for r in verdict["reasons"])

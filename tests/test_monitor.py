@@ -36,6 +36,51 @@ def test_inf_and_magnitude_bound():
     assert mon(0, {"f": jnp.full((2, 2, 2), 5.0)})
 
 
+def test_check_now_reports_actual_step():
+    """Regression (PR 4 satellite): check_now used to hardwire step 0
+    into SimulationDiverged and the diverged event regardless of the
+    actual simulation step."""
+    mon = ps.HealthMonitor(every=50)
+    bad = {"f": jnp.full((4, 4, 4), np.nan)}
+    with pytest.raises(ps.SimulationDiverged) as exc:
+        mon.check_now(bad, step=1234)
+    assert exc.value.step == 1234
+    # omitted step still defaults to 0 (back-compat)
+    with pytest.raises(ps.SimulationDiverged) as exc:
+        mon.check_now(bad)
+    assert exc.value.step == 0
+
+
+def test_monitor_async_observe_poll():
+    """The async mode: observe every step (no sync), poll converts only
+    vectors >= every steps behind, flush drains the tail."""
+    mon = ps.HealthMonitor(every=4)
+    state = {"f": jnp.ones((4, 4, 4))}
+    for step in range(1, 10):
+        mon.observe(step, state)
+        mon.poll()
+        if mon.checked_through is not None:
+            assert mon.checked_through <= step - 4
+    assert mon.checked_through == 5
+    mon.flush()
+    assert mon.checked_through == 9
+    assert mon.history[-1]["step"] == 9
+
+
+def test_monitor_async_trip_names_field_and_step():
+    mon = ps.HealthMonitor(every=2, max_abs=10.0)
+    good = {"f": jnp.ones((4, 4, 4))}
+    blown = {"f": jnp.full((4, 4, 4), 100.0)}
+    for step in range(1, 5):
+        mon.observe(step, good)
+        mon.poll()
+    mon.observe(5, blown)
+    with pytest.raises(ps.SimulationDiverged) as exc:
+        mon.flush()
+    assert exc.value.step == 5
+    assert exc.value.bad_fields == ("f",)
+
+
 def test_step_timer():
     t = ps.StepTimer(report_every=0.0)
     # the first tick only starts the clock (so the first reported window
