@@ -82,7 +82,29 @@ CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: events (compile, fallbacks, mg_cycle, device_memory) interleave with
 #: the orchestrator's lifecycle events in one greppable record.
 #: Override with BENCH_EVENT_LOG.
-EVENTS_PATH = os.environ.get("BENCH_EVENT_LOG") or os.path.join(
+_CONFIG = None
+
+
+def cfg():
+    """The central env-var registry (``pystella_tpu/config.py``),
+    loaded BY FILE like ``obs/events.py`` below — the module is
+    stdlib-only, so the jax-free orchestrator can consult every
+    registered ``BENCH_*`` knob without importing the package."""
+    global _CONFIG
+    if _CONFIG is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "pystella_tpu", "config.py")
+        spec = importlib.util.spec_from_file_location("_bench_config", path)
+        _CONFIG = importlib.util.module_from_spec(spec)
+        # dataclasses resolves cls.__module__ through sys.modules at
+        # class-creation time, so the by-file module must be registered
+        sys.modules[spec.name] = _CONFIG
+        spec.loader.exec_module(_CONFIG)
+    return _CONFIG
+
+
+EVENTS_PATH = cfg().getenv("BENCH_EVENT_LOG") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "bench_results", "run_events.jsonl")
 
@@ -121,7 +143,7 @@ def cache_append(rec):
 
 def cache_load():
     """Most recent cached line per metric, in first-seen metric order."""
-    if os.environ.get("BENCH_NO_CACHE", "0") == "1":
+    if cfg().get_bool("BENCH_NO_CACHE"):
         return []
     lines = []
     try:
@@ -222,7 +244,8 @@ def _resolve_fused(fused, grid_shape=None):
 
 
 def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
-                       fused="auto", decomp=None, make_state=True):
+                       fused="auto", decomp=None, make_state=True,
+                       donate=False):
     import jax
     import pystella_tpu as ps
 
@@ -247,7 +270,7 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
             # one pass over HBM per stage
             stepper = ps.FusedScalarStepper(
                 sector, decomp, grid_shape, lattice.dx, halo_shape,
-                dtype=dtype)
+                dtype=dtype, donate=donate)
         except ValueError as e:
             # no streaming blocking AND over the resident VMEM budget
             # (the _resolve_fused gate is a heuristic; construction is
@@ -263,7 +286,10 @@ def build_preheat_step(grid_shape, dtype=np.float32, halo_shape=2,
             return sector_rhs(state, t, lap_f=derivs.lap(state["f"]),
                               a=a, hubble=hubble)
 
-        stepper = ps.LowStorageRK54(full_rhs, dt=dt)
+        # donate: the driver loops rebind state = step(state), so the
+        # old buffers are dead — aliasing them into the outputs halves
+        # the state's HBM footprint (the IR-tier lint audits this)
+        stepper = ps.LowStorageRK54(full_rhs, dt=dt, donate=donate)
 
     if not make_state:  # callers supplying their own initial state
         return stepper, None, dt
@@ -325,7 +351,7 @@ def run_preheat(n, nsteps=10, dtype=np.float32, fused="auto"):
     sync(state)
     elapsed = time.perf_counter() - start
 
-    profile_dir = os.environ.get("BENCH_PROFILE")
+    profile_dir = cfg().getenv("BENCH_PROFILE")
     if profile_dir:
         # capture a SEPARATE extra chunk (outside the timed window —
         # tracing overhead must not contaminate the reported number);
@@ -809,7 +835,8 @@ def run_smoke(argv=None):
              nsteps=args.steps)
 
     t = np.float32(0.0)
-    stepper, state, dt = build_preheat_step(grid_shape, fused=False)
+    stepper, state, dt = build_preheat_step(grid_shape, fused=False,
+                                            donate=True)
     rhs_args = {"a": np.float32(1.0), "hubble": np.float32(0.5)}
     compiled, rec = obs.compile_with_report(
         stepper._jit_step, state, t, dt, rhs_args, label="smoke_step")
@@ -887,6 +914,44 @@ def run_smoke(argv=None):
                  bytes_per_step=overlap_seg[0].traced_halo_bytes(),
                  label="smoke-overlap")
 
+    # static analysis, end to end: the SOURCE tier over the package and
+    # the IR tier over the very step executable this run just timed —
+    # the verdict lands in the event log (kind="lint"), the ledger's
+    # `lint` report section, and the gate's refusal trigger, plus
+    # lint_report.json next to the perf report
+    from pystella_tpu import lint as _lint
+    lint_rep = _lint.run_lint(run_graph=False)
+    try:
+        asm = stepper._jit_step.lower(
+            state, t, dt, rhs_args).compiler_ir().operation.get_asm(
+                enable_debug_info=True)
+        graph_violations, graph_stats = _lint.audit_artifacts(
+            "smoke_step", asm, compiled.as_text(),
+            donatable_bytes=sum(v.nbytes for v in state.values()),
+            dtype_policy=_lint.POLICY_F32,
+            fused_scopes=("rk_stage",))
+        lint_rep.extend(graph_violations)
+        lint_rep.graph = {"smoke_step": graph_stats}
+        lint_rep.donation = graph_stats.get("donation")
+        for chk in _lint.GRAPH_CHECKS:
+            lint_rep.add_check(chk)
+    except Exception as e:  # noqa: BLE001 — record, never kill the run
+        lint_rep.extend([_lint.Violation(
+            checker="graph-build", where="smoke_step", severity="warning",
+            message=f"IR audit of the smoke step failed: "
+                    f"{type(e).__name__}: {e}")])
+    lint_path = lint_rep.write(os.path.join(args.out, "lint_report.json"))
+    lint_summary = lint_rep.summary()
+    hb(f"smoke: lint {'PASS' if lint_rep.ok else 'FAIL'} "
+       f"({lint_summary['errors']} error(s), "
+       f"{lint_summary['warnings']} warning(s)) -> {lint_path}")
+    obs.emit("lint", ok=lint_rep.ok, errors=lint_summary["errors"],
+             warnings=lint_summary["warnings"],
+             checks=lint_summary["checks"],
+             donation=lint_summary.get("donation"),
+             first_errors=[str(v) for v in lint_rep.errors[:5]],
+             report_path=lint_path)
+
     ledger = obs.PerfLedger.from_events(
         events_path, registry=obs.registry(), label=f"smoke-{n}^3",
         step_label="smoke_step")
@@ -914,11 +979,10 @@ def payload(platform_wanted):
     """Dial the device, run every config smallest-first, emit a JSON line
     the moment each succeeds. Runs inside a subprocess so a wedged dial or
     readback can always be abandoned by the parent."""
-    grids = [int(g) for g in
-             os.environ.get("BENCH_GRIDS", "128,256,512").split(",")]
-    dial_budget = float(os.environ.get("BENCH_DIAL_BUDGET", "1800"))
-    budget = float(os.environ.get("BENCH_CONFIG_BUDGET", "300"))
-    extras = os.environ.get("BENCH_EXTRAS", "1") != "0"
+    grids = [int(g) for g in cfg().getenv("BENCH_GRIDS").split(",")]
+    dial_budget = cfg().get_float("BENCH_DIAL_BUDGET")
+    budget = cfg().get_float("BENCH_CONFIG_BUDGET")
+    extras = cfg().get_bool("BENCH_EXTRAS")
 
     # framework-internal obs events (compile reports, tier fallbacks,
     # mg_cycle, device_memory) land in the same JSONL record as the
@@ -965,7 +1029,7 @@ def payload(platform_wanted):
         grids = [g for g in grids if g <= 128] or [min(grids)]
         hb(f"cpu: grids reduced to {grids}")
     suffix = "" if platform == "tpu" else f", {platform}"
-    suffix += os.environ.get("BENCH_SUFFIX_EXTRA", "")
+    suffix += cfg().getenv("BENCH_SUFFIX_EXTRA")
 
     largest = None
     for n in sorted(grids):
@@ -1008,11 +1072,11 @@ def payload(platform_wanted):
             traceback.print_exc()
 
     if extras:
-        wave_n = int(os.environ.get("BENCH_WAVE_N", "64"))
-        spec_n = int(os.environ.get("BENCH_SPECTRA_N",
-                                    "64" if platform == "cpu" else "256"))
-        mg_n = int(os.environ.get("BENCH_MG_N",
-                                  "64" if platform == "cpu" else "512"))
+        wave_n = cfg().get_int("BENCH_WAVE_N")
+        spec_n = cfg().get_int(
+            "BENCH_SPECTRA_N", "64" if platform == "cpu" else "256")
+        mg_n = cfg().get_int(
+            "BENCH_MG_N", "64" if platform == "cpu" else "512")
         # multigrid's many-level V-cycle is compile-heavy: ~365 s of XLA
         # compile at 512^3 on v5e (measured), so it gets a doubled budget
         configs = [
@@ -1026,21 +1090,21 @@ def payload(platform_wanted):
         if platform == "tpu":
             # compiled-only configs (fused kernels run interpret-mode on
             # CPU — pointlessly slow)
-            gw_n = int(os.environ.get("BENCH_GW_N", "256"))
+            gw_n = cfg().get_int("BENCH_GW_N")
             configs.insert(2, (
                 f"gw-step-{gw_n}^3", lambda: run_gw_step(gw_n),
                 "site-updates/s", 1e9, budget))
-            if os.environ.get("BENCH_GW_BF16C", "1") != "0":
+            if cfg().get_bool("BENCH_GW_BF16C"):
                 # the single-chip-512^3 GW memory configuration:
                 # bfloat16 RK carries (~12.6 GB peak vs 17.2 GB f32)
                 import jax.numpy as _jnp
-                bf_n = int(os.environ.get("BENCH_GW_BF16C_N", "512"))
+                bf_n = cfg().get_int("BENCH_GW_BF16C_N")
                 configs.insert(3, (
                     f"gw-step-{bf_n}^3-bf16carry",
                     lambda: run_gw_step(
                         bf_n, carry_dtype=_jnp.bfloat16),
                     "site-updates/s", 1e9, 2 * budget))
-            cp_n = int(os.environ.get("BENCH_COUPLED_N", "512"))
+            cp_n = cfg().get_int("BENCH_COUPLED_N")
             # 2x budget: the deferred-drag pair path Mosaic-compiles two
             # kernel variants (normal-in + deferred-in) per y-slab plus
             # the single-stage energy kernel for odd tails
@@ -1141,9 +1205,9 @@ def run_payload(platform, timeout, extra_env=None, cache=False):
 
 def main():
     cached = cache_load()
-    total_budget = float(os.environ.get(
-        "BENCH_TOTAL_BUDGET", "1500" if cached else "2400"))
-    force_cpu = os.environ.get("BENCH_FORCE_CPU", "0") == "1"
+    total_budget = cfg().get_float(
+        "BENCH_TOTAL_BUDGET", "1500" if cached else "2400")
+    force_cpu = cfg().get_bool("BENCH_FORCE_CPU")
     # leave room to capture a CPU number if every TPU attempt fails
     cpu_reserve = 240.0
     hb(f"orchestrator: total budget {total_budget:.0f}s "
@@ -1165,7 +1229,7 @@ def main():
     # hardware lines already emitted, the CPU insurance number is
     # redundant — skip it and put the budget toward the TPU dial.
     got_insurance = 0
-    if (os.environ.get("BENCH_CPU_FIRST", "1") != "0" and not force_cpu
+    if (cfg().get_bool("BENCH_CPU_FIRST") and not force_cpu
             and not cached):
         ins_budget = min(300.0, total_budget - cpu_reserve
                          - (time.time() - T0))

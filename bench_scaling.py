@@ -29,7 +29,20 @@ import os
 import sys
 import time
 
-_cpu = os.environ.get("PYSTELLA_BENCH_PLATFORM", "cpu") == "cpu"
+def _cfg():
+    """The central env registry, loaded BY FILE (pre-jax, pre-package —
+    the same trick bench.py's orchestrator uses)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "pystella_tpu", "config.py")
+    spec = importlib.util.spec_from_file_location("_scaling_config", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_cpu = _cfg().getenv("PYSTELLA_BENCH_PLATFORM") == "cpu"
 if _cpu:
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:

@@ -116,7 +116,7 @@ def main(argv=None):
         # record
         ps.obs.configure(p.event_log)
     if p.perf_report is not None and p.event_log is None \
-            and not os.environ.get("PYSTELLA_EVENT_LOG"):
+            and not ps.config.getenv("PYSTELLA_EVENT_LOG"):
         raise ValueError("--perf-report digests the event log: pass "
                          "--event-log (or set PYSTELLA_EVENT_LOG)")
     p.grid_shape = tuple(p.grid_shape)
@@ -202,7 +202,12 @@ def main(argv=None):
                              a=np.float64(a))
 
     # observables
-    out = ps.OutputFile(runfile=__file__, name=p.outfile) \
+    # default output lands in bench_results/ beside the other run
+    # artifacts (an explicit --outfile path is honored as given)
+    out = ps.OutputFile(
+        runfile=__file__, name=p.outfile,
+        out_dir=os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench_results")) \
         if decomp.rank == 0 else None
     statistics = ps.FieldStatistics(decomp, grid_size=p.grid_size)
     spectra = ps.PowerSpectra(decomp, fft, lattice.dk, lattice.volume)

@@ -14,6 +14,7 @@ generator and compiler, lattices are ``jax.Array``s sharded over a
 ``jax.sharding.Mesh``, and communication is XLA collectives over ICI/DCN.
 """
 
+from pystella_tpu import config
 from pystella_tpu.field import (
     Field, DynamicField, Expr, Var, Shifted,
     diff, simplify, substitute, evaluate, field_names, shift_fields,
@@ -97,7 +98,7 @@ __all__ = [
     "SpectralCollocator", "SpectralPoissonSolver",
     "Sector", "ScalarSector", "TensorPerturbationSector", "tensor_index",
     "get_rho_and_p", "Expansion", "OutputFile", "ShardedSnapshot",
-    "timer", "Checkpointer", "obs",
+    "timer", "Checkpointer", "obs", "config",
     "HealthMonitor", "SimulationDiverged", "StepTimer", "trace",
     "Stepper", "RungeKuttaStepper", "LowStorageRKStepper", "compile_rhs_dict",
     "RungeKutta4", "RungeKutta3Heun", "RungeKutta3Nystrom",

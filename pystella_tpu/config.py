@@ -1,0 +1,232 @@
+"""Central environment-variable registry.
+
+Every ``PYSTELLA_*`` / ``BENCH_*`` knob the package or its drivers read
+is declared here — name, default, type, and a one-line description —
+and read through :func:`getenv` / the typed getters. The source-tier
+lint (:mod:`pystella_tpu.lint.source`) enforces the contract: an
+``os.environ`` read of a project-prefixed variable anywhere else in
+``pystella_tpu/`` fails CI unless the site carries an explicit
+``# env-registry: NAME`` pragma naming a variable registered here (the
+escape hatch for the stdlib-only modules that must stay loadable BY
+FILE in a jax-free supervisor and therefore cannot import this module
+through the package).
+
+The table in ``doc/observability.md`` ("Environment variables") is the
+human rendering; the lint's ``env-doc`` check fails when a registered
+variable is missing from it, so registry and doc cannot drift.
+
+This module is stdlib-only and free of package-relative imports, so a
+supervisor that must not import jax can load it by file (the same trick
+``bench.py`` uses for ``obs/events.py``)::
+
+    spec = importlib.util.spec_from_file_location(
+        "_cfg", ".../pystella_tpu/config.py")
+
+Reads are LIVE (no import-time caching): sweep harnesses vary knobs
+like ``PYSTELLA_VMEM_LIMIT_MB`` between kernel builds in one process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+__all__ = ["EnvVar", "register", "registered", "getenv", "get_int",
+           "get_float", "get_bool", "snapshot"]
+
+_UNSET = object()
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One registered environment variable."""
+
+    name: str
+    default: str | None
+    help: str
+    kind: str = "str"        # str | int | float | bool | path
+    #: where it is consumed: "package" (pystella_tpu/ runtime),
+    #: "driver" (bench/example scripts), "test" (suite config), or
+    #: "external" (not ours — documented because reports fingerprint it)
+    scope: str = "package"
+
+
+#: name -> EnvVar, in registration order
+_REGISTRY: dict[str, EnvVar] = {}
+
+
+def register(name, default=None, help="", kind="str", scope="package"):
+    """Register a variable (idempotent for identical declarations);
+    returns ``name``. Conflicting re-registration raises — two call
+    sites disagreeing about a default is exactly the config drift the
+    registry exists to prevent."""
+    var = EnvVar(name=str(name), default=default, help=help, kind=kind,
+                 scope=scope)
+    existing = _REGISTRY.get(var.name)
+    if existing is not None and existing != var:
+        raise ValueError(
+            f"env var {name!r} already registered with a different "
+            f"declaration: {existing} vs {var}")
+    _REGISTRY[var.name] = var
+    return var.name
+
+
+def registered():
+    """The registry as a name -> :class:`EnvVar` dict (copy)."""
+    return dict(_REGISTRY)
+
+
+def getenv(name, default=_UNSET):
+    """The raw string value of a REGISTERED variable (the registered
+    default — or ``default`` when given — when unset). Reading an
+    unregistered name raises ``KeyError``: register it first."""
+    var = _REGISTRY.get(name)
+    if var is None:
+        raise KeyError(
+            f"env var {name!r} is not registered in pystella_tpu.config "
+            "— declare it there (with a default and description) before "
+            "reading it")
+    fallback = var.default if default is _UNSET else default
+    val = os.environ.get(name)
+    return fallback if val is None else val
+
+
+def get_int(name, default=_UNSET):
+    val = getenv(name, default)
+    return None if val is None else int(float(val))
+
+
+def get_float(name, default=_UNSET):
+    val = getenv(name, default)
+    return None if val is None else float(val)
+
+
+#: accepted spellings for boolean variables (everything else is False,
+#: matching ``parallel.overlap.env_setting``'s tolerant parse)
+_TRUE = ("1", "true", "on", "yes")
+
+
+def get_bool(name, default=_UNSET):
+    val = getenv(name, default)
+    if val is None:
+        return None
+    return str(val).strip().lower() in _TRUE
+
+
+def snapshot():
+    """``{name: raw value}`` for every registered variable currently
+    set in the process environment (no defaults) — the config side of a
+    forensic/environment fingerprint."""
+    return {name: os.environ[name] for name in _REGISTRY
+            if name in os.environ}
+
+
+# ---------------------------------------------------------------------------
+# the registry: package runtime knobs
+# ---------------------------------------------------------------------------
+
+register("PYSTELLA_EVENT_LOG", default=None, kind="path",
+         help="JSONL run-event log path picked up by obs.events.get_log() "
+              "when no explicit obs.configure() call was made; unset "
+              "disables implicit event logging")
+register("PYSTELLA_HALO_OVERLAP", default="auto", kind="bool",
+         help="halo-exchange/compute overlap policy for sharded stencils: "
+              "1/0 force on/off, unset/'auto' enables exactly when the "
+              "mesh shards a lattice axis (parallel.overlap.enabled)")
+register("PYSTELLA_VMEM_LIMIT_MB", default="100", kind="float",
+         help="per-kernel Mosaic scoped-VMEM request in MiB "
+              "(ops.pallas_stencil.vmem_limit_bytes); read at each "
+              "kernel build so sweeps can vary it in-process")
+register("PYSTELLA_BLOCK_BUDGET_MB", default="24", kind="float",
+         help="VMEM budget in MiB that ops.pallas_stencil.choose_blocks "
+              "fits the streaming window ring into")
+
+# ---------------------------------------------------------------------------
+# driver knobs (bench.py / bench_scaling.py / examples)
+# ---------------------------------------------------------------------------
+
+register("PYSTELLA_BENCH_PLATFORM", default="cpu", scope="driver",
+         help="platform for the benchmark scripts and test-file "
+              "__main__ blocks: 'cpu' (default; forces the virtual CPU "
+              "mesh) or 'tpu' (leaves the remote-TPU plugin registered)")
+register("PYSTELLA_LINT_PLATFORM", default="cpu", scope="driver",
+         help="platform the lint CLI lowers the audited step functions "
+              "on: 'cpu' (default; static analysis needs no hardware) "
+              "or 'tpu'")
+register("BENCH_EVENT_LOG", default=None, kind="path", scope="driver",
+         help="override for bench.py's run-event JSONL path (default "
+              "bench_results/run_events.jsonl)")
+register("BENCH_NO_CACHE", default="0", kind="bool", scope="driver",
+         help="1 ignores bench_results/tpu_lines.jsonl (persisted "
+              "hardware lines) when re-emitting cached metrics")
+register("BENCH_PROFILE", default=None, kind="path", scope="driver",
+         help="log dir: wrap each preheat timing window in a "
+              "jax.profiler capture; per-scope durations land in the "
+              "event log as trace_summary events")
+register("BENCH_GRIDS", default="128,256,512", scope="driver",
+         help="comma-separated cube edge sizes the bench payload runs "
+              "smallest-first")
+register("BENCH_DIAL_BUDGET", default="1800", kind="float", scope="driver",
+         help="seconds allowed per TPU-payload device dial")
+register("BENCH_CONFIG_BUDGET", default="300", kind="float", scope="driver",
+         help="seconds allowed per config once the device is up")
+register("BENCH_TOTAL_BUDGET", default=None, kind="float", scope="driver",
+         help="seconds for the whole bench run (default 1500 when "
+              "cached hardware lines exist, else 2400)")
+register("BENCH_EXTRAS", default="1", kind="bool", scope="driver",
+         help="0 skips the secondary config matrix (wave equation, "
+              "GW+spectra, multigrid, coupled)")
+register("BENCH_FORCE_CPU", default="0", kind="bool", scope="driver",
+         help="1 skips TPU attempts entirely")
+register("BENCH_CPU_FIRST", default="1", kind="bool", scope="driver",
+         help="0 skips the labeled CPU insurance number captured before "
+              "the TPU attempts")
+register("BENCH_SUFFIX_EXTRA", default="", scope="driver",
+         help="extra text appended to bench metric names (sweep "
+              "harness labeling)")
+register("BENCH_WAVE_N", default="64", kind="int", scope="driver",
+         help="wave-equation config grid edge")
+register("BENCH_SPECTRA_N", default=None, kind="int", scope="driver",
+         help="GW+spectra config grid edge (default: 64 on cpu, 256 on "
+              "tpu)")
+register("BENCH_MG_N", default=None, kind="int", scope="driver",
+         help="multigrid config grid edge (default: 64 on cpu, 512 on "
+              "tpu)")
+register("BENCH_GW_N", default="256", kind="int", scope="driver",
+         help="GW-stepper config grid edge")
+register("BENCH_GW_BF16C", default="1", kind="bool", scope="driver",
+         help="0 skips the bf16-compute GW config")
+register("BENCH_GW_BF16C_N", default="512", kind="int", scope="driver",
+         help="bf16-compute GW config grid edge")
+register("BENCH_COUPLED_N", default="512", kind="int", scope="driver",
+         help="coupled-expansion chunk config grid edge")
+
+# ---------------------------------------------------------------------------
+# test-suite knobs (read by tests/conftest.py and tests/common.py, which
+# run before the package imports — registered for the doc table)
+# ---------------------------------------------------------------------------
+
+register("PYSTELLA_TEST_PLATFORM", default="cpu", scope="test",
+         help="pytest suite platform: 'tpu' runs the suite on hardware "
+              "(Pallas kernels Mosaic-compiled); default is the virtual "
+              "8-device CPU mesh")
+
+# ---------------------------------------------------------------------------
+# external variables we read or set (not project-prefixed; documented
+# because perf-report fingerprints and the gate's flag-mismatch warning
+# depend on them)
+# ---------------------------------------------------------------------------
+
+register("XLA_FLAGS", default=None, scope="external",
+         help="XLA compiler/runtime flags; scheduler-relevant entries "
+              "are fingerprinted into perf reports "
+              "(obs.ledger.xla_flag_fingerprint)")
+register("LIBTPU_INIT_ARGS", default=None, scope="external",
+         help="libtpu init flags; parallel.overlap.ensure_scheduler_flags "
+              "appends the async-collective/latency-hiding-scheduler "
+              "set before the TPU backend dials")
+register("JAX_PLATFORMS", default=None, scope="external",
+         help="jax backend selection; tests force 'cpu'")
+register("JAX_ENABLE_X64", default=None, scope="external",
+         help="jax 64-bit mode; the test suite enables it for "
+              "reference-parity f64 tolerances")

@@ -1,0 +1,175 @@
+"""The lint report schema (``lint_report.json``).
+
+One JSON document per lint run, consumed three ways: humans read the
+CLI's rendering of it, the :class:`~pystella_tpu.obs.ledger.PerfLedger`
+folds its summary into a perf report's ``lint`` section, and
+:mod:`pystella_tpu.obs.gate` refuses perf evidence whose lint failed.
+Stdlib-only (no jax) so supervisors can load and parse reports anywhere.
+
+Schema (v1)::
+
+    {
+      "schema": 1,
+      "generated_ts": <float>,
+      "ok": <bool>,                  # no error-severity violations
+      "summary": {
+        "errors": <int>, "warnings": <int>,
+        "checks": [<checker names that ran>],
+        "targets": [<graph-tier target names>],
+        "donation": {                # graph tier, absent without it
+          "donatable_bytes": <int>,  # bytes audited as should-donate
+          "aliased_bytes": <int>,    # bytes actually aliased in the IR
+          "coverage_pct": <float>,   # 100 * aliased / donatable
+          "wasted_bytes": <int>,     # the HBM cost of the misses
+        },
+      },
+      "violations": [
+        {"checker": ..., "severity": "error"|"warning",
+         "where": "<file:line or target name>", "message": ...,
+         "detail": {...}},            # checker-specific evidence
+      ],
+      "graph": {<target>: {<audit>: {...stats...}}},
+      "source": {"files_scanned": <int>, "package": <path>},
+    }
+
+Round-trip: ``LintReport.from_dict(json.loads(dumps(rep.to_dict())))``
+is identity on the schema fields (pinned by tests/test_lint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+__all__ = ["LINT_SCHEMA_VERSION", "Violation", "LintReport"]
+
+LINT_SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass
+class Violation:
+    """One lint finding. ``severity`` is ``"error"`` (fails the run)
+    or ``"warning"`` (recorded, does not fail)."""
+
+    checker: str
+    message: str
+    where: str = ""
+    severity: str = "error"
+    detail: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self):
+        return {"checker": self.checker, "severity": self.severity,
+                "where": self.where, "message": self.message,
+                "detail": self.detail}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(checker=d["checker"], message=d["message"],
+                   where=d.get("where", ""),
+                   severity=d.get("severity", "error"),
+                   detail=dict(d.get("detail") or {}))
+
+    def __str__(self):
+        return f"[{self.severity}] {self.checker}: {self.where}: " \
+               f"{self.message}"
+
+
+@dataclasses.dataclass
+class LintReport:
+    """Aggregates violations + per-tier stats into the schema above."""
+
+    violations: list = dataclasses.field(default_factory=list)
+    checks: list = dataclasses.field(default_factory=list)
+    graph: dict = dataclasses.field(default_factory=dict)
+    source: dict = dataclasses.field(default_factory=dict)
+    donation: dict | None = None
+    generated_ts: float | None = None
+
+    def extend(self, violations):
+        self.violations.extend(violations)
+
+    def add_check(self, name):
+        if name not in self.checks:
+            self.checks.append(name)
+
+    @property
+    def errors(self):
+        return [v for v in self.violations if v.severity == "error"]
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    def summary(self):
+        s = {
+            "errors": len(self.errors),
+            "warnings": len([v for v in self.violations
+                             if v.severity == "warning"]),
+            "checks": list(self.checks),
+            "targets": sorted(self.graph),
+        }
+        if self.donation is not None:
+            s["donation"] = dict(self.donation)
+        return s
+
+    def to_dict(self):
+        return {
+            "schema": LINT_SCHEMA_VERSION,
+            "generated_ts": (time.time() if self.generated_ts is None
+                             else self.generated_ts),
+            "ok": self.ok,
+            "summary": self.summary(),
+            "violations": [v.to_dict() for v in self.violations],
+            "graph": self.graph,
+            "source": self.source,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        if d.get("schema") != LINT_SCHEMA_VERSION:
+            raise ValueError(
+                f"unsupported lint report schema {d.get('schema')!r} "
+                f"(this reader understands v{LINT_SCHEMA_VERSION})")
+        rep = cls(
+            violations=[Violation.from_dict(v)
+                        for v in d.get("violations") or []],
+            checks=list((d.get("summary") or {}).get("checks") or []),
+            graph=dict(d.get("graph") or {}),
+            source=dict(d.get("source") or {}),
+            donation=(d.get("summary") or {}).get("donation"),
+            generated_ts=d.get("generated_ts"),
+        )
+        return rep
+
+    def write(self, path):
+        """Write ``lint_report.json``; returns ``path``."""
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        return path
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def render_text(self):
+        """Human rendering for the CLI."""
+        s = self.summary()
+        lines = [f"lint: {'PASS' if self.ok else 'FAIL'} — "
+                 f"{s['errors']} error(s), {s['warnings']} warning(s); "
+                 f"checks: {', '.join(s['checks']) or '(none)'}"]
+        if s.get("targets"):
+            lines.append("graph targets: " + ", ".join(s["targets"]))
+        don = s.get("donation")
+        if don:
+            lines.append(
+                f"donation coverage: {don['coverage_pct']:.1f}% "
+                f"({don['aliased_bytes']:,} of "
+                f"{don['donatable_bytes']:,} donatable bytes aliased"
+                + (f"; {don['wasted_bytes']:,} B wasted"
+                   if don.get("wasted_bytes") else "") + ")")
+        for v in self.violations:
+            lines.append(str(v))
+        return "\n".join(lines)

@@ -1,0 +1,230 @@
+"""The audited step functions: small, CPU-safe builds of the real
+production computations.
+
+Every builder constructs the SAME ``jax.jit`` objects the drivers
+dispatch (``Stepper._jit_step``, ``Stepper._health_jit``,
+``FusedScalarStepper._multi_jit`` / ``_coupled_jit``, the multigrid
+smoother) on a tiny lattice, so the audited jaxpr/HLO is the real step
+program — only the shapes are small. Builders run lazily inside
+:func:`~pystella_tpu.lint.graph.audit_target`; a build failure is
+itself a lint finding.
+
+The sharded targets want >= 4 devices (the lint CLI forces an 8-device
+host-platform mesh, like the test suite); with fewer they degrade to a
+single-device mesh and the collective audit trivially passes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pystella_tpu.lint.graph import POLICY_F32, GraphTarget
+
+__all__ = ["default_targets", "GRID"]
+
+#: audited lattice (tiny: the hazards are shape-independent)
+GRID = (16, 16, 16)
+
+#: the ppermutes of a halo exchange are the one collective a sharded
+#: stencil step is allowed to carry
+HALO_COLLECTIVES = {
+    "collective-permute": "halo exchange ppermutes "
+                          "(parallel.decomp / parallel.overlap)",
+}
+
+#: sentinel / energy reductions over a sharded mesh land as all-reduce
+REDUCTION_COLLECTIVES = {
+    "all-reduce": "registered in-graph reductions (obs.sentinel health "
+                  "vector, fused energy sums)",
+}
+
+
+def _mesh_decomp(want_sharded):
+    import jax
+    import pystella_tpu as ps
+    if want_sharded and len(jax.devices()) >= 4:
+        return ps.DomainDecomposition((2, 2, 1),
+                                      devices=jax.devices()[:4])
+    return ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])
+
+
+def _preheat_parts(decomp, dtype=np.float32):
+    """The smoke/bench two-field preheating system on ``GRID``:
+    ``(stepper_rhs, state, t, dt, rhs_args)`` ingredients shared by the
+    generic-step targets."""
+    import pystella_tpu as ps
+    lattice = ps.Lattice(GRID, (5.0, 5.0, 5.0), dtype=dtype)
+    dt = dtype(0.1 * min(lattice.dx))
+    mphi, gsq = 1.20e-6, 2.5e-7
+
+    def potential(f):
+        phi, chi = f[0], f[1]
+        return (mphi**2 / 2 * phi**2 + gsq / 2 * phi**2 * chi**2) / mphi**2
+
+    sector = ps.ScalarSector(2, potential=potential)
+    derivs = ps.FiniteDifferencer(decomp, 2, lattice.dx, mode="halo")
+    sector_rhs = ps.compile_rhs_dict(sector.rhs_dict)
+
+    def full_rhs(state, t, a, hubble):
+        return sector_rhs(state, t, lap_f=derivs.lap(state["f"]),
+                          a=a, hubble=hubble)
+
+    rng = np.random.default_rng(7)
+    state = {
+        "f": decomp.shard(
+            1e-3 * rng.standard_normal((2,) + GRID).astype(dtype)),
+        "dfdt": decomp.shard(
+            1e-4 * rng.standard_normal((2,) + GRID).astype(dtype)),
+    }
+    rhs_args = {"a": dtype(1.0), "hubble": dtype(0.5)}
+    return full_rhs, state, dtype(0.0), dt, rhs_args
+
+
+def build_step_generic():
+    """The generic (XLA-tier) LowStorageRK54 step on a sharded mesh —
+    the ``bench.py --smoke`` step program."""
+    import pystella_tpu as ps
+    decomp = _mesh_decomp(want_sharded=True)
+    full_rhs, state, t, dt, rhs_args = _preheat_parts(decomp)
+    stepper = ps.LowStorageRK54(full_rhs, dt=dt, donate=True)
+    return stepper._jit_step, (state, t, dt, rhs_args), {}, state
+
+
+def build_step_sentinel():
+    """The sentinel-piggybacked step (``Stepper.step_with_health``) on
+    a sharded mesh: health reductions must fuse INTO the step module."""
+    import jax.numpy as jnp
+    import pystella_tpu as ps
+    from pystella_tpu import obs
+    decomp = _mesh_decomp(want_sharded=True)
+    full_rhs, state, t, dt, rhs_args = _preheat_parts(decomp)
+    stepper = ps.LowStorageRK54(full_rhs, dt=dt, donate=True)
+    sentinel = obs.Sentinel.for_state(state, invariants={
+        "kinetic_mean": lambda st, aux: 0.5 * jnp.mean(
+            jnp.sum(jnp.square(st["dfdt"]), axis=0))})
+    fn = stepper._health_jit(sentinel)
+    return fn, (state, t, dt, rhs_args, {}), {}, state
+
+
+def _fused_stepper():
+    import jax.numpy as jnp
+    import pystella_tpu as ps
+    decomp = _mesh_decomp(want_sharded=False)
+    lattice = ps.Lattice(GRID, (5.0, 5.0, 5.0), dtype=np.float32)
+
+    def potential(f):
+        return 0.5 * 1.2e-2 * f[0] ** 2 + 0.125 * f[0] ** 2 * f[1] ** 2
+
+    sector = ps.ScalarSector(2, potential=potential)
+    stepper = ps.FusedScalarStepper(
+        sector, decomp, GRID, lattice.dx, 2, dtype=jnp.float32,
+        bx=4, by=8)
+    rng = np.random.default_rng(11)
+    state = {
+        "f": decomp.shard(
+            1e-3 * rng.standard_normal((2,) + GRID).astype(np.float32)),
+        "dfdt": decomp.shard(
+            1e-4 * rng.standard_normal((2,) + GRID).astype(np.float32)),
+    }
+    dt = np.float32(0.01)
+    return stepper, state, dt
+
+
+def build_fused_multi_step():
+    """``FusedScalarStepper.multi_step`` (2-step chunk with the
+    sentinel piggyback) — the flagship hot-loop program."""
+    import jax.numpy as jnp
+    from pystella_tpu import obs
+    stepper, state, dt = _fused_stepper()
+    sentinel = obs.Sentinel.for_state(state, invariants={
+        "kinetic_mean": lambda st, aux: 0.5 * jnp.mean(
+            jnp.sum(jnp.square(st["dfdt"]), axis=0))})
+    fn = stepper._multi_jit(2, sentinel=sentinel)
+    args = (state,)
+    kwargs = {"t": np.float32(0.0), "dt": dt,
+              "rhs_args": {"a": np.float32(1.0),
+                           "hubble": np.float32(0.5)},
+              "rhs_seq": {}}
+    return fn, args, kwargs, state
+
+
+def build_coupled_multi_step():
+    """``FusedScalarStepper.coupled_multi_step`` (on-device Friedmann
+    background) — the expanding-universe chunk program."""
+    import jax.numpy as jnp
+    stepper, state, dt = _fused_stepper()
+    pair = stepper._ensure_coupled_pair_calls() is not None
+    stepper._ensure_energy_call()
+    grid_size = float(np.prod(GRID))
+    fn = stepper._coupled_jit(2, grid_size, 1.0, pair)
+    args = (state,)
+    kwargs = {"t": np.float32(0.0), "dt": dt,
+              "a": jnp.float32(1.0), "adot": jnp.float32(0.1)}
+    return fn, args, kwargs, state
+
+
+def build_mg_smooth():
+    """The multigrid V-cycle's hot kernel: a level-0 Jacobi smooth on a
+    sharded mesh (the compiled body every cycle dispatches most)."""
+    import jax
+    import pystella_tpu as ps
+    from pystella_tpu.multigrid import JacobiIterator
+    from pystella_tpu.multigrid.relax import LevelSpec
+    decomp = _mesh_decomp(want_sharded=True)
+    solver = JacobiIterator(
+        decomp, {ps.Field("f"): (ps.Field("lap_f"), ps.Field("rho"))},
+        halo_shape=1, dtype=np.float32,
+        fixed_parameters=dict(omega=1 / 2))
+    dx = 10.0 / GRID[0]
+    sharded = any(p > 1 for p in decomp.proc_shape)
+    level = LevelSpec(GRID, (dx,) * 3, sharded)
+    rng = np.random.default_rng(5521)
+    f = decomp.shard(rng.standard_normal(GRID).astype(np.float32))
+    rho = decomp.shard(rng.standard_normal(GRID).astype(np.float32))
+
+    def smooth(fs, rhos):
+        return solver.smooth(level, fs, rhos, {}, 4, decomp)
+
+    fn = jax.jit(smooth)
+    return fn, ({"f": f}, {"rho": rho}), {}, None
+
+
+def default_targets():
+    """The audited target list (build callables stay lazy)."""
+    return [
+        GraphTarget(
+            name="step_generic",
+            build=build_step_generic,
+            dtype_policy=POLICY_F32,
+            collectives=dict(HALO_COLLECTIVES),
+            fused_scopes=("rk_stage",),
+        ),
+        GraphTarget(
+            name="step_sentinel",
+            build=build_step_sentinel,
+            dtype_policy=POLICY_F32,
+            collectives={**HALO_COLLECTIVES, **REDUCTION_COLLECTIVES},
+            fused_scopes=("rk_stage", "sentinel"),
+        ),
+        GraphTarget(
+            name="fused_multi_step",
+            build=build_fused_multi_step,
+            dtype_policy=POLICY_F32,
+            collectives=dict(REDUCTION_COLLECTIVES),
+            fused_scopes=("fused_rk_stage", "sentinel"),
+        ),
+        GraphTarget(
+            name="coupled_multi_step",
+            build=build_coupled_multi_step,
+            dtype_policy=POLICY_F32,
+            collectives=dict(REDUCTION_COLLECTIVES),
+            fused_scopes=("fused_",),
+        ),
+        GraphTarget(
+            name="mg_smooth",
+            build=build_mg_smooth,
+            dtype_policy=POLICY_F32,
+            collectives=dict(HALO_COLLECTIVES),
+            fused_scopes=("mg_smooth",),
+        ),
+    ]

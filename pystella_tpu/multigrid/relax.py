@@ -442,14 +442,15 @@ class RelaxationBase:
     def smooth(self, level, fs, rhos, aux, iterations, decomp=None):
         """Run ``iterations`` relaxation sweeps; returns updated unknowns."""
         decomp = decomp if decomp is not None else self.decomp
+        iterations = int(iterations)
         fs, rhos, aux = self._cast(fs), self._cast(rhos), self._cast(aux)
         with trace_scope("mg_smooth"):
             res = self._try_pallas("smooth", level, fs, rhos, aux, decomp,
-                                   nu=int(iterations))
+                                   nu=iterations)
             if res is not None:
                 return res
             return self._get_compiled(
-                "smooth", level, int(iterations), decomp)(fs, rhos, aux)
+                "smooth", level, iterations, decomp)(fs, rhos, aux)
 
     def residual(self, level, fs, rhos, aux, decomp=None):
         """``rho - L(f)`` per unknown (reference relax.py:216-223)."""

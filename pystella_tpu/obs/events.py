@@ -169,7 +169,11 @@ def get_log():
     raises, so startup misconfiguration surfaces)."""
     global _default
     if _default is None:
-        path = os.environ.get("PYSTELLA_EVENT_LOG") or None
+        # direct read, not pystella_tpu.config.getenv: this module must
+        # stay loadable BY FILE in a jax-free supervisor (bench.py's
+        # orchestrator), where no package import is available
+        path = os.environ.get(
+            "PYSTELLA_EVENT_LOG") or None  # env-registry: PYSTELLA_EVENT_LOG
         try:
             _default = EventLog(path)
         except OSError as e:

@@ -183,7 +183,8 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     outlier_k=5.0, burst_limit=4, frac_limit=0.10,
                     allow_env_mismatch=False,
                     check_contamination="auto", check_numerics=True,
-                    drift_factor=10.0, drift_floor=1e-12):
+                    drift_factor=10.0, drift_floor=1e-12,
+                    check_lint=True):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -195,6 +196,14 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     step times are tight unless someone else holds the chip (the
     round-5 scenario the detector exists for). ``"always"`` /
     ``"never"`` force it either way.
+
+    ``check_lint`` (default on): a run whose ``lint`` section records a
+    FAILED static analysis (:mod:`pystella_tpu.lint` — donation misses,
+    unexpected collectives, host syncs on the step path, ...) is
+    invalid evidence (exit 2): its step times measure a program known
+    to be off the fast path, so they prove nothing about the code as
+    designed. A baseline with lint coverage that the current run lost
+    degrades to a warning.
 
     ``check_numerics`` (default on) extends the gate beyond step times:
     a run whose ``numerics`` section records a sentinel trip is invalid
@@ -215,6 +224,25 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
         verdict["reasons"].append(
             "invalid_evidence: current report has no step samples")
         return verdict
+
+    if check_lint:
+        cur_lint = current.get("lint")
+        if cur_lint and not cur_lint.get("ok", True):
+            verdict.update(ok=False, exit_code=2)
+            verdict["reasons"].append(
+                "invalid_evidence: the run's static analysis FAILED "
+                f"({cur_lint.get('errors', '?')} lint error(s)) — the "
+                "measured program is known to be off the fast path; "
+                "fix the lint findings "
+                + (f"({'; '.join(cur_lint['first_errors'][:3])}) "
+                   if cur_lint.get("first_errors") else "")
+                + "and re-measure")
+            return verdict
+        if (baseline is not None and baseline.get("lint")
+                and not current.get("lint")):
+            verdict["warnings"].append(
+                "lint: baseline carried a static-analysis verdict but "
+                "the current run has none — lint coverage was lost")
 
     cur_num = current.get("numerics") or {}
     if check_numerics and cur_num.get("diverged"):
@@ -419,6 +447,10 @@ def main(argv=None):
     p.add_argument("--no-numerics", action="store_true",
                    help="skip the numerics checks (invariant drift, "
                         "diverged-run invalidation)")
+    p.add_argument("--no-lint", action="store_true",
+                   help="skip the lint check (a failed static analysis "
+                        "in the current report's `lint` section refuses "
+                        "the evidence)")
     p.add_argument("--allow-missing-baseline", action="store_true",
                    help="exit 0 (after the contamination check) when "
                         "the baseline file does not exist")
@@ -452,7 +484,8 @@ def main(argv=None):
         allow_env_mismatch=args.allow_env_mismatch,
         check_contamination=args.check_contamination,
         check_numerics=not args.no_numerics,
-        drift_factor=args.drift_factor, drift_floor=args.drift_floor)
+        drift_factor=args.drift_factor, drift_floor=args.drift_floor,
+        check_lint=not args.no_lint)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

@@ -98,11 +98,14 @@ def xla_flag_fingerprint():
     the gate can warn when two reports differ only in flags."""
     flags = {}
     for var in ("XLA_FLAGS", "LIBTPU_INIT_ARGS"):
+        # direct reads: this module stays loadable by file, jax- and
+        # package-free  # env-registry: XLA_FLAGS, LIBTPU_INIT_ARGS
         for tok in os.environ.get(var, "").split():
             name, _, value = tok.lstrip("-").partition("=")
             if any(m in name for m in _FLAG_MARKERS):
                 flags[name] = value if value else "true"
-    setting = os.environ.get("PYSTELLA_HALO_OVERLAP")
+    setting = os.environ.get(
+        "PYSTELLA_HALO_OVERLAP")  # env-registry: PYSTELLA_HALO_OVERLAP
     if setting is not None:
         flags["PYSTELLA_HALO_OVERLAP"] = setting
     return flags
@@ -213,6 +216,8 @@ class PerfLedger:
         self.health_events = 0          # health events ingested
         self.diverged = []              # sentinel trips (step, fields)
         self.forensic_bundles = []      # bundle paths written this run
+        self.lint = None                # lint-event summary (see lint())
+        self.donated_bytes = None       # aliased bytes in the step compile
 
     # -- ingestion ---------------------------------------------------------
 
@@ -282,6 +287,11 @@ class PerfLedger:
                                          data.get("offending_invariant")})
             elif kind == "forensic_bundle":
                 led.forensic_bundles.append(data.get("path"))
+            elif kind == "lint":
+                # the static-analysis verdict (pystella_tpu.lint): the
+                # report's `lint` section, and the gate's refusal
+                # trigger when the run's lint failed
+                led.lint = data
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -317,6 +327,12 @@ class PerfLedger:
         rec = max(recs, key=lambda r: r["argument_bytes"])
         out = rec.get("output_bytes")
         self.bytes_per_step = int(rec["argument_bytes"]) + int(out or 0)
+        alias = rec.get("alias_bytes")
+        if isinstance(alias, (int, float)):
+            # donated (input->output aliased) bytes the step does NOT
+            # hold twice — the realized HBM saving buffer donation buys
+            # (0 on backends that drop donation, e.g. CPU)
+            self.donated_bytes = int(alias)
 
     # -- derived quantities ------------------------------------------------
 
@@ -343,7 +359,8 @@ class PerfLedger:
         return {"bytes_per_step": self.bytes_per_step,
                 "achieved_gbps": achieved,
                 "peak_gbps": peak,
-                "fraction_of_peak": frac}
+                "fraction_of_peak": frac,
+                "donated_bytes": self.donated_bytes}
 
     def overlap_summary(self):
         """Exposed-vs-hidden communication time of the overlapped halo
@@ -467,6 +484,7 @@ class PerfLedger:
             "roofline": self.roofline(),
             "overlap": self.overlap_summary(),
             "numerics": self.numerics(),
+            "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
             "metrics": self.metrics,
@@ -551,8 +569,30 @@ def render_markdown(rep):
         f"- achieved {_fmt(rf.get('achieved_gbps'))} GB/s of "
         f"{_fmt(rf.get('peak_gbps'))} GB/s peak -> "
         f"{_fmt(rf.get('fraction_of_peak'), '.1%')} of roofline",
+        f"- donated (input->output aliased) bytes: "
+        f"{_fmt(rf.get('donated_bytes'), ',.0f')} — HBM the step does "
+        "not hold twice (from the step compile's alias analysis)",
         "",
     ]
+    lint = rep.get("lint")
+    if lint:
+        lines += ["## Lint", ""]
+        lines.append(
+            f"- static analysis {'PASSED' if lint.get('ok') else '**FAILED**'}"
+            f": {_fmt(lint.get('errors'), '.0f', '0')} error(s), "
+            f"{_fmt(lint.get('warnings'), '.0f', '0')} warning(s) "
+            f"({', '.join(lint.get('checks') or []) or 'no checks'})")
+        don = lint.get("donation") or {}
+        if don:
+            lines.append(
+                f"- donation coverage {_fmt(don.get('coverage_pct'), '.1f')}%"
+                f" ({_fmt(don.get('aliased_bytes'), ',.0f')} of "
+                f"{_fmt(don.get('donatable_bytes'), ',.0f')} donatable "
+                f"step-state bytes aliased; "
+                f"{_fmt(don.get('wasted_bytes'), ',.0f')} B wasted)")
+        for reason in (lint.get("first_errors") or [])[:5]:
+            lines.append(f"- {reason}")
+        lines.append("")
     ov = rep.get("overlap")
     if ov:
         lines += ["## Communication overlap", ""]

@@ -83,13 +83,19 @@ def _max_abs_and_mean_sq(x):
     on the CPU backend, and on TPU one pass means the health stats ride
     a single read of the state the step just wrote)."""
     x = jnp.asarray(x)
-    ax = jnp.abs(x).ravel()
-    sq = jnp.square(x).ravel()
+    # reduce over the ORIGINAL axes — an earlier ravel()-then-reduce
+    # formulation forced the SPMD partitioner to all-gather every
+    # sharded field before the 1-D reshape (a full per-field lattice
+    # transfer per health vector), which the IR-tier lint's collective
+    # audit caught the first time it ran; the multi-axis reduce keeps
+    # the pass shard-local with one tiny scalar all-reduce at the end
+    ax = jnp.abs(x)
+    sq = jnp.square(x)
     zero = jnp.zeros((), ax.dtype)
     mx, s = jax.lax.reduce(
         (ax, sq), (zero, zero),
         lambda acc, v: (jnp.maximum(acc[0], v[0]), acc[1] + v[1]),
-        (0,))
+        tuple(range(ax.ndim)))
     return mx, s / x.size
 
 

@@ -660,6 +660,32 @@ class FusedScalarStepper(_step.Stepper):
             i += 1
         return self.extract(carry)
 
+    def _multi_jit(self, nsteps, rhs_seq=None, sentinel=None):
+        """The cached jitted ``nsteps``-chunk executable (state arg
+        donated). Factored out of :meth:`multi_step` so the IR audit
+        (``pystella_tpu.lint``) can ``.lower()`` the exact dispatched
+        computation without running it."""
+        key = (int(nsteps), tuple(sorted(rhs_seq)) if rhs_seq else None,
+               None if sentinel is None else id(sentinel))
+        fn = self._jit_multi.get(key)
+        if fn is None:
+            import functools
+            import jax
+            impl = functools.partial(self._multi_step_impl,
+                                     nsteps=int(nsteps))
+            if sentinel is not None:
+                base_impl = impl
+
+                def impl(state, t, dt, rhs_args, rhs_seq):
+                    new = base_impl(state, t=t, dt=dt,
+                                    rhs_args=rhs_args, rhs_seq=rhs_seq)
+                    with trace_scope("sentinel"):
+                        hv = sentinel.compute(new)
+                    return new, hv
+            fn = jax.jit(impl, donate_argnums=0)
+            self._jit_multi[key] = fn
+        return fn
+
     def multi_step(self, state, nsteps, t=0.0, dt=None, rhs_args=None,
                    rhs_seq=None, sentinel=None):
         """Advance ``nsteps`` full RK steps as one jitted computation,
@@ -700,25 +726,7 @@ class FusedScalarStepper(_step.Stepper):
                         f"rhs_seq[{n!r}] has {v.shape[0]} entries; need "
                         f"one per stage ({nsteps} steps x "
                         f"{self.num_stages} stages = {nflat})")
-        key = (nsteps, tuple(sorted(rhs_seq)) if rhs_seq else None,
-               None if sentinel is None else id(sentinel))
-        fn = self._jit_multi.get(key)
-        if fn is None:
-            import functools
-            import jax
-            impl = functools.partial(self._multi_step_impl,
-                                     nsteps=nsteps)
-            if sentinel is not None:
-                base_impl = impl
-
-                def impl(state, t, dt, rhs_args, rhs_seq):
-                    new = base_impl(state, t=t, dt=dt,
-                                    rhs_args=rhs_args, rhs_seq=rhs_seq)
-                    with trace_scope("sentinel"):
-                        hv = sentinel.compute(new)
-                    return new, hv
-            fn = jax.jit(impl, donate_argnums=0)
-            self._jit_multi[key] = fn
+        fn = self._multi_jit(nsteps, rhs_seq, sentinel)
         _metrics.counter("steps").inc(nsteps)
         return fn(state, t=t, dt=dt, rhs_args=rhs_args or {},
                   rhs_seq=rhs_seq or {})
@@ -1035,6 +1043,35 @@ class FusedScalarStepper(_step.Stepper):
             carry = self._finalize_deferred(carry, dt, hubfix, B2p)
         return self.extract(carry), a, adot
 
+    def _coupled_jit(self, nsteps, grid_size, mpl, pair, sentinel=None):
+        """The cached jitted coupled-chunk executable (state donated;
+        signature ``fn(state, t=, dt=, a=, adot=)``). Factored out of
+        :meth:`coupled_multi_step` for the same reason as
+        :meth:`_multi_jit` — the IR audit lowers it without running."""
+        import functools
+        import jax
+        key = (int(nsteps), float(grid_size), float(mpl), bool(pair),
+               None if sentinel is None else id(sentinel))
+        fn = self._jit_coupled.get(key)
+        if fn is None:
+            impl = self._coupled_pair_impl if pair else self._coupled_impl
+            impl = functools.partial(impl, nsteps=int(nsteps),
+                                     grid_size=float(grid_size),
+                                     mpl=float(mpl))
+            if sentinel is not None:
+                base_impl = impl
+
+                def impl(state, t, dt, a, adot):
+                    new, a2, adot2 = base_impl(state, t=t, dt=dt, a=a,
+                                               adot=adot)
+                    with trace_scope("sentinel"):
+                        hv = sentinel.compute(new, {"a": a2,
+                                                    "adot": adot2})
+                    return new, a2, adot2, hv
+            fn = jax.jit(impl, donate_argnums=0)
+            self._jit_coupled[key] = fn
+        return fn
+
     def coupled_multi_step(self, state, nsteps, expansion, t=0.0,
                            dt=None, grid_size=None, pair=None,
                            sentinel=None):
@@ -1081,25 +1118,7 @@ class FusedScalarStepper(_step.Stepper):
                 "A[0] != 0, a hubble-referencing potential, or no "
                 "feasible blocking)")
         self._ensure_energy_call()  # pair path's odd-tail stage uses it
-        key = (nsteps, grid_size, mpl, bool(pair),
-               None if sentinel is None else id(sentinel))
-        fn = self._jit_coupled.get(key)
-        if fn is None:
-            impl = self._coupled_pair_impl if pair else self._coupled_impl
-            impl = functools.partial(impl, nsteps=nsteps,
-                                     grid_size=grid_size, mpl=mpl)
-            if sentinel is not None:
-                base_impl = impl
-
-                def impl(state, t, dt, a, adot):
-                    new, a2, adot2 = base_impl(state, t=t, dt=dt, a=a,
-                                               adot=adot)
-                    with trace_scope("sentinel"):
-                        hv = sentinel.compute(new, {"a": a2,
-                                                    "adot": adot2})
-                    return new, a2, adot2, hv
-            fn = jax.jit(impl, donate_argnums=0)
-            self._jit_coupled[key] = fn
+        fn = self._coupled_jit(nsteps, grid_size, mpl, pair, sentinel)
         _metrics.counter("steps").inc(nsteps)
         res = fn(state, t=t, dt=dt,
                  a=jnp.asarray(float(expansion.a)),

@@ -33,8 +33,6 @@ smoothers. On CPU backends the kernels run in Pallas interpret mode.
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
 import jax
@@ -44,6 +42,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from pystella_tpu import _compat
+from pystella_tpu import config as _config
 from pystella_tpu.obs.scope import trace_scope
 
 __all__ = ["StreamingStencil", "ResidentStencil", "OverlapStreamingStencil",
@@ -81,8 +80,7 @@ def vmem_limit_bytes():
     matching how :func:`choose_blocks` reads ``PYSTELLA_BLOCK_BUDGET_MB``
     — so sweep harnesses can vary it between builds in one process (an
     import-time read froze the first value for the whole run)."""
-    return int(float(os.environ.get("PYSTELLA_VMEM_LIMIT_MB", "100"))
-               * 2**20)
+    return int(_config.get_float("PYSTELLA_VMEM_LIMIT_MB") * 2**20)
 
 
 #: import-time snapshot of :func:`vmem_limit_bytes`, kept for callers
@@ -140,8 +138,7 @@ def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
     conservative default until a sweep shows bigger wins
     (bench_results/r05_pair_sweep.py)."""
     if budget is None:
-        budget = int(float(
-            os.environ.get("PYSTELLA_BLOCK_BUDGET_MB", "24")) * 2**20)
+        budget = int(_config.get_float("PYSTELLA_BLOCK_BUDGET_MB") * 2**20)
     X, Y, Z = lattice_shape
     best = None
     for by in (256, 128, 64, 32, 16, 8):

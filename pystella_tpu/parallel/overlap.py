@@ -42,6 +42,8 @@ from __future__ import annotations
 import logging
 import os
 
+from pystella_tpu import config as _config
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["enabled", "env_setting", "ensure_scheduler_flags",
@@ -75,7 +77,7 @@ _FLAG_MARKERS = ("async_collective", "async_all_gather",
 def env_setting():
     """The raw ``PYSTELLA_HALO_OVERLAP`` setting: ``True``/``False`` for
     an explicit 1/0, ``None`` for unset/auto."""
-    val = os.environ.get("PYSTELLA_HALO_OVERLAP", "auto").strip().lower()
+    val = _config.getenv("PYSTELLA_HALO_OVERLAP").strip().lower()
     if val in ("1", "true", "on", "yes"):
         return True
     if val in ("0", "false", "off", "no"):

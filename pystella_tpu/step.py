@@ -164,6 +164,24 @@ class Stepper:
         dt = dt if dt is not None else self.dt
         return self._jit_step(state, t, dt, rhs_args or {})
 
+    def _health_jit(self, sentinel):
+        """The cached jitted step+health executable for ``sentinel``
+        (also the IR-audit entry point: ``pystella_tpu.lint`` lowers it
+        without dispatching to prove the sentinel reductions fuse into
+        the step module)."""
+        cache = self.__dict__.setdefault("_jit_health_step", {})
+        fn = cache.get(id(sentinel))
+        if fn is None:
+            def impl(state, t, dt, rhs_args, aux):
+                new = self._step_impl(state, t, dt, rhs_args)
+                with trace_scope("sentinel"):
+                    hv = sentinel.compute(new, aux)
+                return new, hv
+            fn = jax.jit(impl, donate_argnums=(
+                (0,) if getattr(self, "_donate", False) else ()))
+            cache[id(sentinel)] = fn
+        return fn
+
     def step_with_health(self, state, sentinel, t=0.0, dt=None,
                          rhs_args=None, aux=None):
         """Like :meth:`step`, additionally returning ``sentinel``'s
@@ -177,17 +195,7 @@ class Stepper:
         background) is forwarded to the sentinel's invariants. Returns
         ``(new_state, health_vector)``."""
         dt = dt if dt is not None else self.dt
-        cache = self.__dict__.setdefault("_jit_health_step", {})
-        fn = cache.get(id(sentinel))
-        if fn is None:
-            def impl(state, t, dt, rhs_args, aux):
-                new = self._step_impl(state, t, dt, rhs_args)
-                with trace_scope("sentinel"):
-                    hv = sentinel.compute(new, aux)
-                return new, hv
-            fn = jax.jit(impl, donate_argnums=(
-                (0,) if getattr(self, "_donate", False) else ()))
-            cache[id(sentinel)] = fn
+        fn = self._health_jit(sentinel)
         return fn(state, t, dt, rhs_args or {}, aux or {})
 
     # -- per-stage interface (reference-style driver loops) ----------------

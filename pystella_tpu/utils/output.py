@@ -36,19 +36,34 @@ class OutputFile:
         numeric suffix chosen to avoid collisions (reference output.py:92-96).
     :arg runfile: path to the invoking script, whose text is stored
         (defaults to ``sys.argv[0]``).
+    :arg out_dir: directory the file (and the collision scan for the
+        default name) lives in; created if missing. Defaults to the
+        cwd. Drivers should pass a results directory (the examples use
+        ``bench_results/``) so run artifacts never litter the repo
+        root. Ignored when ``name`` is already an explicit path with a
+        directory component.
 
     Any other keyword arguments are recorded as file attributes.
     """
 
-    def __init__(self, context=None, name=None, runfile=None, **kwargs):
+    def __init__(self, context=None, name=None, runfile=None,
+                 out_dir=None, **kwargs):
         import h5py
 
+        if out_dir and not (name and os.path.dirname(name)):
+            os.makedirs(out_dir, exist_ok=True)
+        else:
+            out_dir = None
         if name is None:
             i = 0
-            while os.path.exists(f"output-{i}.h5"):
+            while os.path.exists(os.path.join(out_dir or ".",
+                                              f"output-{i}.h5")):
                 i += 1
             name = f"output-{i}"
-        self.filename = name if name.endswith(".h5") else name + ".h5"
+        filename = name if name.endswith(".h5") else name + ".h5"
+        if out_dir:
+            filename = os.path.join(out_dir, filename)
+        self.filename = filename
         self.file = h5py.File(self.filename, "a")
 
         # run provenance (reference output.py:98-152)

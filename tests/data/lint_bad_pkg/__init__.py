@@ -1,0 +1,4 @@
+"""Seeded-violation fixture package for the source-tier lint
+(tests/test_lint.py): every module here contains a deliberate hazard
+the linter must name. Never imported — the AST tier reads files only.
+"""

@@ -1,0 +1,9 @@
+"""Fixture: unregistered / unrouted env-var reads."""
+
+import os
+
+# seeded violation: project-prefixed read of a name no registry declares
+SECRET_KNOB = os.environ.get("PYSTELLA_BOGUS_KNOB", "7")
+
+# seeded violation: registered-style name read directly without pragma
+EVENT_LOG = os.environ.get("PYSTELLA_EVENT_LOG")

@@ -1,0 +1,16 @@
+# lint: hot-path
+"""Fixture: a hot-path module with forbidden host syncs."""
+
+import numpy as np
+
+from pystella_tpu.obs.scope import trace_scope
+
+
+def bad_step(state):
+    # seeded violation: .item() inside a hot-path module
+    norm = state["f"].sum().item()
+    with trace_scope("not_a_registered_scope"):
+        # seeded violations: float()/np.asarray inside a traced region
+        scale = float(state["dt"])
+        host_copy = np.asarray(state["f"])
+    return norm, scale, host_copy

@@ -1,0 +1,53 @@
+"""Seeded-violation IR-tier targets for the lint CLI fixture test:
+
+    python -m pystella_tpu.lint --no-source \
+        --targets lint_fixture_targets:TARGETS
+
+Each target lowers a tiny synthetic computation carrying exactly one
+hazard the graph audits must name: an un-donated fake step, a silent
+f64 upcast, and a host callback on the "step" path.
+"""
+
+from pystella_tpu.lint.graph import POLICY_F32, GraphTarget
+
+
+def build_undonated_step():
+    """A state-in/state-out step jitted WITHOUT donation — the audit
+    must report the full state as wasted HBM."""
+    import jax
+    import jax.numpy as jnp
+    state = {"f": jnp.ones((64, 64), jnp.float32)}
+    fn = jax.jit(lambda s: {"f": s["f"] * 2.0 + 1.0})
+    return fn, (state,), {}, state
+
+
+def build_f64_step():
+    """An f32 input silently upcast to f64 mid-computation."""
+    import jax
+    import jax.numpy as jnp
+    jax.config.update("jax_enable_x64", True)
+    x = jnp.ones((16, 16), jnp.float32)
+    fn = jax.jit(lambda v: (v.astype(jnp.float64) * 2.0).sum())
+    return fn, (x,), {}, None
+
+
+def build_callback_step():
+    """A host callback (jax.debug.print) inside the step."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(v):
+        jax.debug.print("sum {}", v.sum())
+        return v + 1.0
+
+    return jax.jit(f), (jnp.ones(8, jnp.float32),), {}, None
+
+
+TARGETS = [
+    GraphTarget(name="undonated_step", build=build_undonated_step,
+                dtype_policy=POLICY_F32),
+    GraphTarget(name="f64_step", build=build_f64_step,
+                dtype_policy=POLICY_F32),
+    GraphTarget(name="callback_step", build=build_callback_step,
+                dtype_policy=POLICY_F32),
+]

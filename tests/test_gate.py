@@ -465,3 +465,37 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     verdict = gate.compare_reports(rep, drift)
     assert any("numerics regression" in r and "kinetic_mean" in r
                for r in verdict["reasons"])
+
+    # the static-analysis tier ran end to end inside the smoke run: the
+    # report carries a PASSING `lint` section (clean repo, donated
+    # smoke step) and lint_report.json sits next to the perf report
+    lint = rep["lint"]
+    assert lint["ok"] is True, lint
+    assert lint["errors"] == 0
+    assert {"host-sync", "env-registry", "scope-registry", "donation",
+            "collectives", "host"} <= set(lint["checks"])
+    assert lint["donation"]["coverage_pct"] == 100.0
+    assert os.path.exists(os.path.join(out, "lint_report.json"))
+    assert "## Lint" in md and "donation coverage" in md
+
+    # a FAILED lint refuses the evidence (exit 2), whatever the step
+    # times say; --no-lint opts out
+    bad = dict(rep)
+    bad["lint"] = {"ok": False, "errors": 3,
+                   "first_errors": ["[error] donation: smoke_step: ..."]}
+    bad_path = str(tmp_path / "badlint.json")
+    json.dump(bad, open(bad_path, "w"))
+    assert gate.main(["--baseline", report_path,
+                      "--current", bad_path]) == 2
+    assert gate.main(["--baseline", report_path, "--current", bad_path,
+                      "--no-lint"]) == 0
+    capsys.readouterr()
+    verdict = gate.compare_reports(rep, bad)
+    assert verdict["exit_code"] == 2
+    assert any("static analysis FAILED" in r for r in verdict["reasons"])
+    # losing lint coverage relative to the baseline is a warning
+    nolint = {k: v for k, v in rep.items() if k != "lint"}
+    verdict = gate.compare_reports(rep, nolint)
+    assert verdict["exit_code"] == 0
+    assert any("lint coverage was lost" in w
+               for w in verdict["warnings"])

@@ -1,0 +1,349 @@
+"""The static-analysis layer (pystella_tpu.lint): source-tier AST
+checks, IR-tier jaxpr/HLO audits, the seeded-violation fixtures, the
+report schema round-trip, and the donation satellite's bit-exactness
+pin. The full CLI (both tiers over the real repo) runs in
+``test_cli_clean_repo``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import common  # noqa: F401  (side effect: forces the CPU platform)
+
+import jax
+import jax.numpy as jnp
+
+import pystella_tpu as ps
+from pystella_tpu import lint
+from pystella_tpu.lint import graph as lint_graph
+from pystella_tpu.lint import source as lint_source
+from pystella_tpu.lint.report import LintReport, Violation
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "pystella_tpu")
+BAD_PKG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data", "lint_bad_pkg")
+
+
+def _sub_env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, os.path.dirname(os.path.abspath(__file__))])
+    return env
+
+
+# -- source tier -----------------------------------------------------------
+
+def test_source_tier_clean_on_repo():
+    """The package itself carries no source-tier violations — this IS
+    the CI gate for host syncs, env reads, scope literals, and env-var
+    doc coverage."""
+    violations, stats = lint_source.check_package(
+        PKG, doc_path=os.path.join(REPO, "doc", "observability.md"))
+    assert stats["files_scanned"] > 40
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_source_tier_names_seeded_violations():
+    violations, _ = lint_source.check_package(
+        BAD_PKG, registered_scopes=frozenset({"registered"}))
+    by_checker = {}
+    for v in violations:
+        by_checker.setdefault(v.checker, []).append(v)
+    # .item() in a # lint: hot-path module
+    assert any(".item()" in v.message and "hotmod.py" in v.where
+               for v in by_checker["host-sync"])
+    # float()/np.asarray inside a trace_scope block
+    assert any("float()" in v.message for v in by_checker["host-sync"])
+    assert any("np.asarray" in v.message
+               for v in by_checker["host-sync"])
+    # unregistered env reads (no config.py in the fixture package)
+    assert any("PYSTELLA_BOGUS_KNOB" in v.message
+               for v in by_checker["env-registry"])
+    # unregistered trace-scope literal
+    assert any("not_a_registered_scope" in v.message
+               for v in by_checker["scope-registry"])
+
+
+def test_source_tier_pragma_waives():
+    """`# lint: allow(...)` / `# env-registry: NAME` waive a finding at
+    that site — pinned on the package's own by-file-loadable modules,
+    which carry the env pragmas."""
+    violations, _ = lint_source.check_package(
+        PKG, checks={"env-registry"})
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_env_registry_statically_recovered():
+    names = lint_source.registered_env_vars(
+        os.path.join(PKG, "config.py"))
+    assert {"PYSTELLA_EVENT_LOG", "PYSTELLA_HALO_OVERLAP",
+            "BENCH_GRIDS", "XLA_FLAGS"} <= names
+    # and it matches the live registry exactly
+    assert names == set(ps.config.registered())
+
+
+def test_config_accessors():
+    assert ps.config.getenv("PYSTELLA_HALO_OVERLAP") is not None
+    assert ps.config.get_float("PYSTELLA_VMEM_LIMIT_MB") > 0
+    with pytest.raises(KeyError):
+        ps.config.getenv("PYSTELLA_NOT_A_KNOB")
+    snap = ps.config.snapshot()
+    assert all(k in ps.config.registered() for k in snap)
+
+
+# -- report schema ---------------------------------------------------------
+
+def test_report_schema_round_trip(tmp_path):
+    rep = LintReport()
+    rep.extend([
+        Violation(checker="donation", message="miss", where="t1",
+                  detail={"wasted_bytes": 64}),
+        Violation(checker="env-doc", message="undocumented",
+                  severity="warning"),
+    ])
+    rep.add_check("donation")
+    rep.graph = {"t1": {"built": True}}
+    rep.donation = {"donatable_bytes": 128, "aliased_bytes": 64,
+                    "coverage_pct": 50.0, "wasted_bytes": 64}
+    assert not rep.ok
+    path = rep.write(str(tmp_path / "lint_report.json"))
+    loaded = LintReport.load(path)
+    assert loaded.to_dict()["summary"] == rep.to_dict()["summary"]
+    assert [v.to_dict() for v in loaded.violations] \
+        == [v.to_dict() for v in rep.violations]
+    assert loaded.graph == rep.graph
+    assert not loaded.ok
+    # unknown schema versions are refused, not misread
+    bad = rep.to_dict()
+    bad["schema"] = 99
+    with pytest.raises(ValueError):
+        LintReport.from_dict(bad)
+
+
+# -- IR tier ---------------------------------------------------------------
+
+def test_param_parser_handles_sharding_attrs():
+    asm = ('func.func public @main(%arg0: tensor<2x8xf32> '
+           '{jax.buffer_donor = true, mhlo.sharding = '
+           '"{devices=[1,2,2,1]<=[4]}"}, %arg1: tensor<8xf32>, '
+           '%arg2: tensor<f32> {tf.aliasing_output = 0 : i32}) '
+           '-> (tensor<2x8xf32>) {')
+    params = lint_graph.parse_main_params(asm)
+    assert [p[0] for p in params] == [0, 1, 2]
+    assert "jax.buffer_donor" in params[0][3]
+    assert params[1][3].strip() == ""
+    assert "tf.aliasing_output" in params[2][3]
+    assert lint_graph.tensor_nbytes(params[0][1], params[0][2]) == 64
+
+
+def test_audit_donation_reports_waste():
+    asm = ('func.func public @main(%arg0: tensor<4x4xf32>, '
+           '%arg1: tensor<f32>) -> (tensor<4x4xf32>) {')
+    violations, stats = lint_graph.audit_donation("t", asm, 64)
+    assert stats["aliased_bytes"] == 0 and stats["wasted_bytes"] == 64
+    assert violations and "donation miss" in violations[0].message
+    asm_donated = asm.replace(
+        "tensor<4x4xf32>,", "tensor<4x4xf32> {jax.buffer_donor = true},")
+    violations, stats = lint_graph.audit_donation("t", asm_donated, 64)
+    assert violations == [] and stats["coverage_pct"] == 100.0
+
+
+def test_audit_step_sentinel_target():
+    """One real IR-tier target end to end in-process: the sharded
+    sentinel-piggybacked step must be clean — donation covered, no f64,
+    only allowlisted collectives, sentinel fused into the step module."""
+    from pystella_tpu.lint.targets import default_targets
+    target = [t for t in default_targets()
+              if t.name == "step_sentinel"][0]
+    violations, stats = lint_graph.audit_target(target)
+    assert stats["built"], stats
+    assert violations == [], "\n".join(str(v) for v in violations)
+    assert stats["donation"]["coverage_pct"] == 100.0
+    assert stats["fusion"]["scopes"] == {"rk_stage": True,
+                                         "sentinel": True}
+    if len(jax.devices()) >= 4:
+        # the sharded mesh's halo ppermutes are present and small at
+        # this toy size; nothing outside the allowlist survived
+        col = stats["collectives"]
+        assert col["small"].get("collective-permute")
+        assert not set(col["seen"]) - {"collective-permute",
+                                       "all-reduce"}
+
+
+def test_audit_catches_seeded_graph_hazards():
+    import lint_fixture_targets as fx
+    by_name = {}
+    for t in fx.TARGETS:
+        v, _ = lint_graph.audit_target(t)
+        by_name[t.name] = v
+    assert any(v.checker == "donation" and "donation miss" in v.message
+               for v in by_name["undonated_step"])
+    assert any(v.checker == "dtype" and "f64" in v.message
+               for v in by_name["f64_step"])
+    assert any(v.checker == "host" for v in by_name["callback_step"])
+
+
+# -- CLI -------------------------------------------------------------------
+
+def test_cli_source_fixture_exits_1():
+    """`python -m pystella_tpu.lint` on the seeded package exits 1 and
+    NAMES the violations."""
+    res = subprocess.run(
+        [sys.executable, "-m", "pystella_tpu.lint", "--no-graph",
+         "--package", BAD_PKG, "--out", "/tmp/lint_fixture_out"],
+        capture_output=True, text=True, timeout=180, env=_sub_env())
+    assert res.returncode == 1, (res.stdout, res.stderr[-1500:])
+    assert ".item()" in res.stdout
+    assert "PYSTELLA_BOGUS_KNOB" in res.stdout
+    rep = json.load(open("/tmp/lint_fixture_out/lint_report.json"))
+    assert rep["ok"] is False and rep["summary"]["errors"] >= 4
+
+
+@pytest.mark.slow
+def test_cli_graph_fixture_exits_1():
+    """The CLI leg of the seeded IR-tier fixtures (their audit logic is
+    tier-1 via test_audit_catches_seeded_graph_hazards; the CLI exit
+    path is tier-1 via test_cli_source_fixture_exits_1 — this
+    subprocess only re-verifies the --targets loader against a fresh
+    interpreter)."""
+    res = subprocess.run(
+        [sys.executable, "-m", "pystella_tpu.lint", "--no-source",
+         "--targets", "lint_fixture_targets:TARGETS",
+         "--out", "/tmp/lint_fixture_graph"],
+        capture_output=True, text=True, timeout=300, env=_sub_env())
+    assert res.returncode == 1, (res.stdout, res.stderr[-1500:])
+    assert "donation miss" in res.stdout
+    assert "f64" in res.stdout
+    assert "host interaction" in res.stdout
+
+
+@pytest.mark.slow
+def test_cli_clean_repo():
+    """The acceptance run: both tiers over the real repo exit 0 (the
+    tier-1 coverage of the same verdict is test_source_tier_clean_on_repo
+    + test_audit_step_sentinel_target + the smoke e2e's in-run lint;
+    this subprocess additionally compiles every default target)."""
+    res = subprocess.run(
+        [sys.executable, "-m", "pystella_tpu.lint",
+         "--out", "/tmp/lint_clean_repo"],
+        capture_output=True, text=True, timeout=540, env=_sub_env())
+    assert res.returncode == 0, (res.stdout, res.stderr[-2000:])
+    rep = json.load(open("/tmp/lint_clean_repo/lint_report.json"))
+    assert rep["ok"] is True
+    assert set(rep["graph"]) == {"step_generic", "step_sentinel",
+                                 "fused_multi_step",
+                                 "coupled_multi_step", "mg_smooth"}
+    assert rep["summary"]["donation"]["coverage_pct"] == 100.0
+
+
+# -- donation satellite ----------------------------------------------------
+
+def test_donation_bit_exact_fused():
+    """donate=True must not change a single bit of the FUSED stepper's
+    output: the Pallas kernels materialize their outputs, so donation
+    only aliases the jit boundary — the flagship hot loop
+    (``multi_step``, which always donates) and the per-step path must
+    agree exactly."""
+    import warnings
+    grid = (16, 16, 16)
+    decomp = ps.DomainDecomposition((1, 1, 1),
+                                    devices=jax.devices()[:1])
+    sector = ps.ScalarSector(
+        2, potential=lambda f: (0.5 * 1.2e-2 * f[0] ** 2
+                                + 0.125 * f[0] ** 2 * f[1] ** 2))
+    rng = np.random.default_rng(3)
+    init = {
+        "f": jnp.asarray(1e-3 * rng.standard_normal((2,) + grid),
+                         jnp.float32),
+        "dfdt": jnp.asarray(1e-4 * rng.standard_normal((2,) + grid),
+                            jnp.float32),
+    }
+    args = {"a": np.float32(1.3), "hubble": np.float32(0.21)}
+    dt = np.float32(0.01)
+
+    def run(donate):
+        state = {k: v.copy() for k, v in init.items()}
+        # pair_stages=False: donation aliases the jit boundary, not the
+        # kernel bodies, so the single-stage kernel pins the contract at
+        # half the interpret-mode compile cost (pairing parity is
+        # test_fused's job)
+        stepper = ps.FusedScalarStepper(
+            sector, decomp, grid, (0.3, 0.25, 0.2), 2,
+            dtype=jnp.float32, bx=4, by=8, donate=donate,
+            pair_stages=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # cpu drops donation
+            for i in range(3):
+                state = stepper.step(state, np.float32(i) * dt, dt,
+                                     args)
+        return state
+
+    plain, donated = run(False), run(True)
+    for k in plain:
+        np.testing.assert_array_equal(np.asarray(plain[k]),
+                                      np.asarray(donated[k]))
+
+
+def test_donation_roundoff_exact_generic():
+    """The generic XLA-tier step under donate=True: XLA legitimately
+    re-fuses around the aliased buffers (the PR-3 finding — composed
+    jits re-contract FMAs at ~1 ulp), so the pin here is agreement to
+    a few f32 ulps over chained steps plus the lowering actually
+    carrying the donation attrs the IR audit reads."""
+    import warnings
+    grid_shape = (8, 8, 8)
+    decomp = ps.DomainDecomposition((1, 1, 1),
+                                    devices=jax.devices()[:1])
+    derivs = ps.FiniteDifferencer(decomp, 2, 0.3)
+    sector = ps.ScalarSector(
+        1, potential=lambda f: 0.5 * 1e-2 * f[0] ** 2)
+    rhs = ps.compile_rhs_dict(sector.rhs_dict)
+
+    def full_rhs(state, t, a, hubble):
+        return rhs(state, t, lap_f=derivs.lap(state["f"]),
+                   a=a, hubble=hubble)
+
+    rng = np.random.default_rng(3)
+    init = {
+        "f": jnp.asarray(
+            1e-3 * rng.standard_normal((1,) + grid_shape),
+            jnp.float32),
+        "dfdt": jnp.asarray(
+            1e-4 * rng.standard_normal((1,) + grid_shape),
+            jnp.float32),
+    }
+    args = {"a": np.float32(1.0), "hubble": np.float32(0.1)}
+    dt = np.float32(0.01)
+
+    def run(donate):
+        stepper = ps.LowStorageRK54(full_rhs, dt=dt, donate=donate)
+        state = {k: v.copy() for k, v in init.items()}
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # cpu drops donation
+            for i in range(5):
+                state = stepper.step(state, np.float32(i) * dt, dt, args)
+        return state
+
+    plain, donated = run(False), run(True)
+    for k in plain:
+        p, d = np.asarray(plain[k]), np.asarray(donated[k])
+        # a handful of ulps of FMA re-contraction, nothing more
+        np.testing.assert_allclose(p, d, rtol=1e-5, atol=1e-10)
+    # and the donated stepper's lowering really carries the attrs
+    stepper = ps.LowStorageRK54(full_rhs, dt=dt, donate=True)
+    asm, _ = lint.lower_and_compile(
+        stepper._jit_step, (init, np.float32(0.0), dt, args))
+    _, stats = lint_graph.audit_donation(
+        "donated", asm, sum(v.nbytes for v in init.values()))
+    assert stats["coverage_pct"] == 100.0
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-v"])

@@ -2,65 +2,61 @@
 ``trace_scope(...)`` / ``named_scope(...)`` literal in ``pystella_tpu/``
 must be registered, so a renamed hot-path scope cannot silently vanish
 from the Perfetto parser's vocabulary and the ledger's per-scope
-tables — the rename either updates the registry or fails here."""
+tables — the rename either updates the registry or fails here.
+
+The grep that used to live in this file is now the source-tier lint's
+``scope-registry`` checker (:mod:`pystella_tpu.lint.source`), shared
+with ``python -m pystella_tpu.lint`` and the smoke run's in-run lint —
+this test drives that one checker and pins its vocabulary-side
+contracts."""
 
 import os
-import re
 
 import pytest
 
 import common  # noqa: F401  (side effect: forces the CPU platform)
 
+from pystella_tpu.lint import source as lint_source
 from pystella_tpu.obs import scope as obs_scope
 from pystella_tpu.obs import trace as obs_trace
 
 PKG = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "pystella_tpu")
 
-#: scope-emitting call sites: trace_scope/traced (obs.scope) and raw
-#: jax.named_scope uses (decomp's halo_exchange). f-string literals
-#: normalize by dropping the interpolated parts (rk_stage{s} ->
-#: rk_stage), matching the parser's fold rule.
-_PATTERNS = (
-    re.compile(r'trace_scope\(\s*f?"([^"]+)"'),
-    re.compile(r"trace_scope\(\s*f?'([^']+)'"),
-    re.compile(r'named_scope\(\s*f?"([^"]+)"'),
-    re.compile(r'traced\(\s*f?"([^"]+)"'),
-)
-
-
-def _scope_literals():
-    found = {}
-    for dirpath, _, files in os.walk(PKG):
-        if "__pycache__" in dirpath:
-            continue
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path) as f:
-                src = f.read()
-            for pat in _PATTERNS:
-                for lit in pat.findall(src):
-                    name = re.sub(r"\{[^{}]*\}", "", lit)
-                    found.setdefault(name, set()).add(
-                        os.path.relpath(path, PKG))
-    return found
-
 
 def test_every_scope_literal_is_registered():
-    found = _scope_literals()
-    # the grep really sees the hot paths (a broken pattern must not
+    violations, stats = lint_source.check_package(
+        PKG, checks={"scope-registry"})
+    found = stats["scope_literals"]
+    # the checker really sees the hot paths (a broken AST walk must not
     # vacuously pass)
     for expected in ("fused_rk_stage_pair", "halo_exchange", "mg_cycle",
                      "pallas_stencil", "sentinel", "rk_stage"):
         assert expected in found, (expected, sorted(found))
-    missing = {name: sorted(files) for name, files in found.items()
-               if name not in obs_scope.registered_scopes()}
-    assert not missing, (
-        f"unregistered trace scopes {missing}: add register_scope() "
-        "entries in pystella_tpu/obs/scope.py so the Perfetto parser "
-        "and ledger tables keep seeing them")
+    assert violations == [], (
+        "unregistered trace scopes — add register_scope() entries in "
+        "pystella_tpu/obs/scope.py so the Perfetto parser and ledger "
+        "tables keep seeing them:\n"
+        + "\n".join(str(v) for v in violations))
+
+
+def test_checker_flags_unregistered_literals():
+    """The lint checker itself must catch a rename (no vacuous pass):
+    run it against a vocabulary missing a known scope."""
+    registered = set(obs_scope.registered_scopes()) - {"rk_stage"}
+    violations, _ = lint_source.check_package(
+        PKG, checks={"scope-registry"},
+        registered_scopes=frozenset(registered))
+    assert any(v.detail.get("scope") == "rk_stage" for v in violations)
+
+
+def test_fstring_literals_fold():
+    """f-string scope names drop their interpolations (rk_stage{s} ->
+    rk_stage), matching the trace parser's fold rule."""
+    _, stats = lint_source.check_package(PKG, checks={"scope-registry"})
+    assert "rk_stage" in stats["scope_literals"]
+    assert not any(name.startswith("rk_stage{")
+                   for name in stats["scope_literals"])
 
 
 def test_parser_vocabulary_is_the_registry():
