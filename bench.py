@@ -48,7 +48,12 @@ BENCH_CPU_FIRST=0 to skip the labeled CPU insurance number captured
 before the TPU attempts, BENCH_NO_CACHE=1 to ignore persisted lines,
 BENCH_PROFILE=<logdir> to wrap each preheat timing window in a
 ``jax.profiler`` capture whose per-scope durations land in the event
-log as ``trace_summary`` events (doc/observability.md).
+log as ``trace_summary`` events (doc/observability.md),
+PYSTELLA_COMPILE_CACHE_DIR to relocate (or ``off`` to disable) the
+persistent XLA compilation cache the payload wires after the dial —
+a re-dialed payload then skips every already-seen backend compile, and
+the payload emits a ``cold_start`` event (time-to-first-step breakdown)
+the perf ledger reports.
 
 ``python bench.py --smoke`` is a different animal: a tiny,
 deterministic, CPU-safe in-process run that exercises the full perf
@@ -69,6 +74,8 @@ import traceback
 import numpy as np
 
 T0 = time.time()
+#: monotonic process-start anchor for time-to-first-step measurements
+PERF_T0 = time.perf_counter()
 
 CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_results", "tpu_lines.jsonl")
@@ -799,6 +806,17 @@ def run_smoke(argv=None):
     p.add_argument("--no-profile", action="store_true",
                    help="skip the jax.profiler capture (the report's "
                         "scope table is then empty)")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="persistent XLA compilation-cache directory "
+                        "(default: $PYSTELLA_COMPILE_CACHE_DIR when "
+                        "explicitly set, else <out>/xla_cache; 'off' "
+                        "disables). Two smoke runs against the same "
+                        "fresh dir are the cold/warm e2e: the second "
+                        "run's cold_start report must show a high hit "
+                        "rate and a lower time-to-first-step")
+    p.add_argument("--no-warmstart", action="store_true",
+                   help="skip the AOT warm-start leg (export the smoke "
+                        "step program, reload it, pin bit-exactness)")
     args = p.parse_args(argv)
 
     import contextlib
@@ -815,9 +833,11 @@ def run_smoke(argv=None):
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
 
+    t_import0 = time.perf_counter()
     import jax
     import pystella_tpu as ps
     from pystella_tpu import obs
+    import_s = time.perf_counter() - t_import0
 
     os.makedirs(args.out, exist_ok=True)
     events_path = os.path.join(args.out, "smoke_events.jsonl")
@@ -827,6 +847,16 @@ def run_smoke(argv=None):
         os.remove(events_path)
     obs.configure(events_path)
 
+    # persistent compilation cache: --cache-dir > an EXPLICITLY set
+    # PYSTELLA_COMPILE_CACHE_DIR > a self-contained dir under --out
+    # (the registered default points under bench_results/, which is
+    # exactly <out> for a default smoke run)
+    cache_dir = args.cache_dir or os.environ.get(
+        "PYSTELLA_COMPILE_CACHE_DIR"  # env-registry: PYSTELLA_COMPILE_CACHE_DIR
+    ) or os.path.join(args.out, "xla_cache")
+    cache_dir = obs.ensure_compilation_cache(cache_dir)
+    hb(f"smoke: compilation cache {cache_dir or 'disabled'}")
+
     n = args.grid
     grid_shape = (n, n, n)
     hb(f"smoke: {n}^3 generic path, {args.steps} steps, "
@@ -834,16 +864,43 @@ def run_smoke(argv=None):
     obs.emit("bench_run", mode="smoke", grid_shape=list(grid_shape),
              nsteps=args.steps)
 
+    # dispatch-policy: the timed/exported executable donates its state
+    # EXCEPT when the persistent cache is wired on a backend where a
+    # cache-served donated executable corrupts repeat calls (the
+    # jax-0.4.37 CPU hazard obs.memory.cache_donation_safe documents —
+    # this very e2e caught the warmed run silently computing garbage).
+    # On CPU the undonated twin is a true twin: XLA:CPU drops donation
+    # (realized alias_bytes is 0), so memory behavior and numerics are
+    # identical. The DONATED production program is still lowered for
+    # the lint donation audit below.
+    donate_exec = cache_dir is None or obs.cache_donation_safe()
     t = np.float32(0.0)
+    t_build0 = time.perf_counter()
     stepper, state, dt = build_preheat_step(grid_shape, fused=False,
-                                            donate=True)
+                                            donate=donate_exec)
+    build_s = time.perf_counter() - t_build0
     rhs_args = {"a": np.float32(1.0), "hubble": np.float32(0.5)}
     compiled, rec = obs.compile_with_report(
         stepper._jit_step, state, t, dt, rhs_args, label="smoke_step")
-    hb(f"smoke: compiled in {rec.compile_seconds:.2f}s "
-       f"(arg+out bytes {((rec.argument_bytes or 0) + (rec.output_bytes or 0)):,})")
-    for _ in range(2):
-        state = compiled(state, t, dt, rhs_args)
+    hb(f"smoke: traced in {rec.trace_seconds:.2f}s, compiled in "
+       f"{rec.compile_seconds:.2f}s (cache "
+       f"{'hit' if rec.cache_hit else 'miss' if rec.cache_hit is False else 'n/a'}"
+       f"; arg+out bytes {((rec.argument_bytes or 0) + (rec.output_bytes or 0)):,})")
+    # keep a host copy of the warmed input: the warm-start leg below
+    # replays the SAME step from it on both the jit and AOT paths (the
+    # donated originals are consumed by the timed loop)
+    t_first0 = time.perf_counter()
+    state = compiled(state, t, dt, rhs_args)
+    sync(state)
+    first_dispatch_s = time.perf_counter() - t_first0
+    time_to_first_step_s = time.perf_counter() - PERF_T0
+    hb(f"smoke: time-to-first-step {time_to_first_step_s:.2f}s "
+       f"(import {import_s:.2f} / build {build_s:.2f} / trace "
+       f"{rec.trace_seconds:.2f} / compile {rec.compile_seconds:.2f} / "
+       f"dispatch {first_dispatch_s:.2f})")
+    ws_input = {k: np.asarray(v) for k, v in state.items()}
+    ws_shardings = {k: v.sharding for k, v in state.items()}
+    state = compiled(state, t, dt, rhs_args)
     sync(state)
 
     # numerics sentinel: a per-step health vector (per-field finite/
@@ -914,6 +971,75 @@ def run_smoke(argv=None):
                  bytes_per_step=overlap_seg[0].traced_halo_bytes(),
                  label="smoke-overlap")
 
+    # AOT warm-start leg: export the very step program this run timed,
+    # reload the artifact, and pin the loaded program bit-exact against
+    # the jit executable from the same input — the round-trip proof the
+    # cold_start report's `warmstart` block carries. save(verify=True)
+    # also runs the exported module once, so its backend compile lands
+    # in the persistent cache for a later warmed process.
+    warm_artifacts = []
+    if not args.no_warmstart:
+        from pystella_tpu.obs import warmstart as obs_warmstart
+
+        def ws_fresh():
+            # the compiled AOT executable requires its lowered input
+            # shardings; replaying from host copies keeps the donated/
+            # consumed originals out of the comparison
+            return {k: jax.device_put(v, ws_shardings[k])
+                    for k, v in ws_input.items()}
+        try:
+            from pystella_tpu import config as _pcfg
+            store = obs_warmstart.WarmstartStore(
+                _pcfg.getenv("PYSTELLA_WARMSTART_DIR")
+                or os.path.join(args.out, "warmstart"))
+            meta = store.save("smoke_step", stepper._jit_step,
+                              (ws_fresh(), t, dt, rhs_args))
+            prog = store.load("smoke_step",
+                              args=(ws_fresh(), t, dt, rhs_args))
+            match = prog is not None
+            bitexact = None
+            if match:
+                # reference = the very executable this run timed (no
+                # second step compile on the smoke budget)
+                ref = compiled(ws_fresh(), t, dt, rhs_args)
+                got = prog(ws_fresh(), t, dt, rhs_args)
+                sync(ref)
+                sync(got)
+                bitexact = all(
+                    np.array_equal(np.asarray(got[k]), np.asarray(ref[k]))
+                    for k in ref)
+            warm_artifacts.append({
+                "label": "smoke_step",
+                "fingerprint": meta["fingerprint"],
+                "match": match, "bitexact": bitexact})
+            hb(f"smoke: warm-start round trip "
+               f"{'bit-exact' if bitexact else 'FAILED' if match else 'MISMATCH'}"
+               f" [{meta['fingerprint']}]")
+        except Exception as e:  # noqa: BLE001 — record, never kill smoke
+            hb(f"smoke: warm-start leg failed: {type(e).__name__}: {e}")
+            traceback.print_exc()
+            warm_artifacts.append({"label": "smoke_step",
+                                   "match": False,
+                                   "reason": f"{type(e).__name__}: {e}"})
+
+    # the cold-start record the ledger's `cold_start` section (and the
+    # gate's cold-start verdicts) are built from
+    totals = obs.compile_totals()
+    obs.emit("cold_start",
+             time_to_first_step_s=time_to_first_step_s,
+             phases={"import_s": import_s, "build_s": build_s,
+                     "trace_s": rec.trace_seconds,
+                     "compile_s": rec.compile_seconds,
+                     "first_dispatch_s": first_dispatch_s},
+             cache={"dir": cache_dir,
+                    "hits": totals["cache_hits"],
+                    "misses": totals["cache_misses"],
+                    "donation_policy": ("donated" if donate_exec else
+                                        "undonated-twin-dispatch")},
+             warmstart={"claimed": bool(warm_artifacts
+                                        and warm_artifacts[0]["match"]),
+                        "artifacts": warm_artifacts})
+
     # static analysis, end to end: the SOURCE tier over the package and
     # the IR tier over the very step executable this run just timed —
     # the verdict lands in the event log (kind="lint"), the ledger's
@@ -922,7 +1048,18 @@ def run_smoke(argv=None):
     from pystella_tpu import lint as _lint
     lint_rep = _lint.run_lint(run_graph=False)
     try:
-        asm = stepper._jit_step.lower(
+        # the donation audit reads the DONATED production program's
+        # StableHLO; when the dispatch policy ran the undonated twin
+        # (donation-unsafe cached backend, see donate_exec above), the
+        # donated variant is lowered here for the audit — lowering
+        # only, never dispatched, so the hazard cannot bite. The
+        # compiled-HLO checks (collectives/dtype/host) still audit the
+        # very executable this run timed.
+        audit_stepper = stepper
+        if not donate_exec:
+            audit_stepper, _, _ = build_preheat_step(
+                grid_shape, fused=False, donate=True, make_state=False)
+        asm = audit_stepper._jit_step.lower(
             state, t, dt, rhs_args).compiler_ir().operation.get_asm(
                 enable_debug_info=True)
         graph_violations, graph_stats = _lint.audit_artifacts(
@@ -1022,8 +1159,16 @@ def payload(platform_wanted):
     hb("payload: smoke matmul OK")
     obs_event("payload_device_up", platform=platform,
               ndevices=len(devices))
-    from pystella_tpu.obs.memory import device_memory_report
+    from pystella_tpu.obs.memory import (
+        device_memory_report, ensure_compilation_cache)
     device_memory_report(label="post-dial")  # no-op on stat-less CPU
+    # persistent compilation cache: a re-dialed payload (the round-3/5
+    # outage pattern is MANY dials per window) pays each program's XLA
+    # backend compile once per cache, not once per process — the
+    # ~365 s multigrid compile at 512^3 becomes a one-time cost
+    cache_dir = ensure_compilation_cache()
+    hb(f"payload: compilation cache {cache_dir or 'disabled'}")
+    dial_s = time.perf_counter() - PERF_T0
 
     if platform == "cpu":
         grids = [g for g in grids if g <= 128] or [min(grids)]
@@ -1044,6 +1189,24 @@ def payload(platform_wanted):
             continue
         emit(f"site-updates/sec/chip ({n}^3 preheating, RK54+lap4{suffix})",
              ups, "site-updates/s", ups / 1e9)
+        if largest is None:
+            # first config up: record the payload's time-to-first-step
+            # (dial + build + trace + compile + warmup) so hardware
+            # runs carry a cold_start section too — against a warmed
+            # cache the compile share collapses (the cold-start leg of
+            # bench_results/tpu_window_validation.py measures exactly
+            # that delta)
+            from pystella_tpu import obs as _obs
+            totals = _obs.compile_totals()
+            obs_event("cold_start",
+                      time_to_first_step_s=(time.perf_counter()
+                                            - PERF_T0),
+                      phases={"dial_s": dial_s,
+                              "trace_s": totals["trace_s"],
+                              "compile_s": totals["compile_s"]},
+                      cache={"dir": cache_dir,
+                             "hits": totals["cache_hits"],
+                             "misses": totals["cache_misses"]})
         largest = (n, ups)
 
     if largest is None:

@@ -1,0 +1,90 @@
+"""Standalone cross-process repro: persistent-cache-served DONATED
+executables corrupt repeat calls on the CPU backend (jax/jaxlib 0.4.37).
+
+Run twice (or more) against the same cache dir::
+
+    rm -rf /tmp/dcr && python bench_results/cache_donation_repro.py
+    python bench_results/cache_donation_repro.py   # cache HIT -> corrupt
+
+Observed on this container: the first (cache-populating) process prints
+one repeated checksum — correct and deterministic. A later process,
+whose backend compile is SERVED from the cache, prints a correct FIRST
+call and then progressively different checksums call over call: the
+deserialized executable behaves as if it carries state across calls
+(an executable-owned buffer is being scribbled). Undonated programs,
+and donated programs compiled fresh, never corrupt. The corruption is
+race-like — most hit-processes trigger, occasionally one stays clean —
+so treat a single clean run as luck, not safety.
+
+This is the measured basis for ``obs.memory.cache_donation_safe()``
+returning False on CPU, for the undonated-twin dispatch policy in
+``bench.py --smoke``, and for the donated-compile cache bypass in
+``compile_with_report`` / ``instrument_jit`` / ``WarmProgram``. The
+TPU-window validation script calls
+``obs.memory.probe_cache_donation_safety()`` to settle the question on
+hardware, where donation is real and the same hazard would corrupt
+production physics.
+"""
+
+import hashlib
+import os
+import sys
+
+import numpy as np
+
+CACHE = os.environ.get("REPRO_CACHE_DIR", "/tmp/dcr")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", CACHE)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+A = (0.0, -0.5, -1.2, -0.7, -0.3)
+B = (0.1, 0.3, 0.8, 0.7, 0.2)
+
+
+def step(state, dt):
+    """A small 2N-storage RK step — the structure that triggers."""
+    y = state
+    k = jax.tree_util.tree_map(lambda x: x * 0, state)
+    for s in range(5):
+        lap = -6.0 * y["f"]
+        for ax in (1, 2, 3):
+            lap = lap + jnp.roll(y["f"], 1, ax) + jnp.roll(y["f"], -1, ax)
+        r = {"f": y["dfdt"], "dfdt": lap - y["f"]}
+        k = jax.tree_util.tree_map(
+            lambda kk, rr, s=s: A[s] * kk + dt * rr, k, r)
+        y = jax.tree_util.tree_map(
+            lambda yy, kk, s=s: yy + B[s] * kk, y, k)
+    return y
+
+
+def main():
+    rng = np.random.default_rng(17)
+    host = {n: rng.standard_normal((2, 16, 16, 16)).astype(np.float32)
+            for n in ("f", "dfdt")}
+    dt = np.float32(0.01)
+
+    def fresh():
+        return {k: jax.device_put(v) for k, v in host.items()}
+
+    donated = jax.jit(step, donate_argnums=0)
+    sums = []
+    for _ in range(6):
+        out = jax.block_until_ready(donated(fresh(), dt))
+        sums.append(hashlib.sha256(
+            np.asarray(out["dfdt"]).tobytes()).hexdigest()[:8])
+    print("checksums:", " ".join(sums))
+    distinct = len(set(sums))
+    print(f"{'CORRUPT' if distinct > 1 else 'clean'} "
+          f"({distinct} distinct result(s) from identical inputs; "
+          f"cache dir {CACHE})")
+    return 1 if distinct > 1 else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

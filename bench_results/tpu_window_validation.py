@@ -1,0 +1,298 @@
+"""Consolidated TPU-window validation: one unattended script for the
+hardware-validation backlog (ROADMAP), priority-ordered so a short
+tunnel window clears the most important items first.
+
+Legs (each a subprocess with its own budget; a wedged dial or crash
+costs one leg, not the window):
+
+1. ``perf_trace``   — PR 2: run the 512³ preheating bench with a
+   profiler capture, require a NON-EMPTY per-scope table in the
+   resulting ``perf_report.json``, and stash it as the first hardware
+   gate baseline (``perf_report_tpu_baseline.json``).
+2. ``overlap``      — PR 3: a ≥2-chip mesh step with a capture; report
+   the exposed-vs-hidden comm split from the
+   ``collective-permute`` / ``halo_overlap_interior`` rows.
+3. ``lint_tpu``     — PR 4+5: ``PYSTELLA_LINT_PLATFORM=tpu`` lint of
+   the Mosaic lowering and realized donation; the sentinel-fusion
+   check runs inside it (required scopes in ONE step module).
+4. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
+   wires a FRESH ``PYSTELLA_COMPILE_CACHE_DIR``, builds the 512³
+   multigrid + preheat step programs cold (recording
+   time-to-first-step and the trace/compile split), and AOT-exports
+   the step programs. Process B re-dials against the SAME cache +
+   warm-start dir and measures the warmed time-to-first-step. Both
+   processes run ``obs.memory.probe_cache_donation_safety()`` on the
+   hardware runtime — process B's probe, whose donated compile is
+   cache-served in a fresh process, is the decisive one. The leg's
+   verdict is the cold/warm delta (the round-3 ~365 s multigrid
+   compile should collapse to cache-retrieval time) plus the
+   donation-safety verdict that decides whether TPU may serve donated
+   programs from the cache at all.
+
+Results append to ``bench_results/tpu_window_results.jsonl`` (one JSON
+line per leg, bench.py line-cache style: a killed window keeps every
+completed leg). Usage::
+
+    python bench_results/tpu_window_validation.py            # all legs
+    python bench_results/tpu_window_validation.py --legs cold_start
+    python bench_results/tpu_window_validation.py --dry-run  # CPU, tiny
+
+``--dry-run`` shrinks grids and forces CPU so the plumbing can be
+rehearsed without a window (the numbers are then meaningless).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "bench_results")
+RESULTS = os.path.join(OUT, "tpu_window_results.jsonl")
+
+T0 = time.time()
+
+
+def hb(msg):
+    print(f"[tpu-window +{time.time() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def record(leg, **payload):
+    rec = {"ts": time.time(), "leg": leg, **payload}
+    os.makedirs(OUT, exist_ok=True)
+    with open(RESULTS, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def run_leg(leg, budget, env_extra=None, argv_extra=()):
+    """Spawn this script's ``--worker <leg>`` in a subprocess."""
+    env = {**os.environ, **(env_extra or {})}
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--worker", leg, *argv_extra]
+    hb(f"leg {leg}: starting (budget {budget:.0f}s)")
+    t0 = time.time()
+    try:
+        res = subprocess.run(cmd, timeout=budget, env=env)
+        rc = res.returncode
+    except subprocess.TimeoutExpired:
+        rc = "timeout"
+    record(leg + "_driver", rc=rc, seconds=round(time.time() - t0, 1))
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# workers (run in subprocesses; these dial the device)
+# ---------------------------------------------------------------------------
+
+def _dial(dry_run):
+    if dry_run:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    else:
+        sys.path.insert(0, REPO)
+        from pystella_tpu.parallel.overlap import ensure_scheduler_flags
+        ensure_scheduler_flags()
+    import jax
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    return jax.default_backend(), len(devs), time.perf_counter() - t0
+
+
+def worker_perf_trace(dry_run):
+    n = 64 if dry_run else 512
+    env = {**os.environ,
+           "BENCH_GRIDS": str(n), "BENCH_EXTRAS": "0",
+           "BENCH_CPU_FIRST": "0", "BENCH_NO_CACHE": "1",
+           "BENCH_PROFILE": os.path.join(OUT, "tpu_window_trace")}
+    if dry_run:
+        env["BENCH_FORCE_CPU"] = "1"
+    rc = subprocess.run([sys.executable,
+                         os.path.join(REPO, "bench.py")],
+                        env=env, timeout=2000).returncode
+    # digest the event log into the first hardware perf report
+    sys.path.insert(0, REPO)
+    from pystella_tpu.obs.ledger import PerfLedger
+    led = PerfLedger.from_events(
+        os.path.join(OUT, "run_events.jsonl"),
+        label=f"tpu-window-preheat-{n}^3")
+    path = led.write(OUT, stem="perf_report_tpu_baseline")
+    rep = led.report()
+    record("perf_trace", rc=rc, report=path,
+           scope_rows=len(rep.get("scopes") or {}),
+           nonempty_scopes=bool(rep.get("scopes")))
+    return 0 if rc == 0 and rep.get("scopes") else 1
+
+
+def worker_overlap(dry_run):
+    local = 64 if dry_run else 256
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_scaling.py"),
+         "--local", str(local), "--devices", "4",
+         "--profile", os.path.join(OUT, "tpu_window_overlap_trace")],
+        timeout=2000).returncode
+    record("overlap", rc=rc)
+    return rc
+
+
+def worker_lint_tpu(dry_run):
+    env = dict(os.environ)
+    if not dry_run:
+        env["PYSTELLA_LINT_PLATFORM"] = "tpu"
+    rc = subprocess.run(
+        [sys.executable, "-m", "pystella_tpu.lint", "--out", OUT],
+        env={**env, "PYTHONPATH": REPO}, timeout=2000).returncode
+    record("lint_tpu", rc=rc,
+           platform="cpu" if dry_run else "tpu")
+    return rc
+
+
+def worker_cold_start(dry_run, phase):
+    """phase='cold': fresh cache, build + time everything, probe
+    donation safety, export AOT artifacts. phase='warm': re-dial
+    against the same cache/warmstart dirs, measure the warmed
+    time-to-first-step."""
+    backend, ndev, dial_s = _dial(dry_run)
+    import numpy as np
+    sys.path.insert(0, REPO)
+    import bench
+    from pystella_tpu import obs
+    from pystella_tpu.obs import memory as obs_memory
+    from pystella_tpu.obs import warmstart as obs_warmstart
+
+    obs.configure(os.path.join(OUT, "tpu_window_events.jsonl"))
+    cache_dir = obs.ensure_compilation_cache(
+        os.path.join(OUT, "tpu_window_xla_cache"))
+    ws_dir = os.path.join(OUT, "tpu_window_warmstart")
+
+    n = 32 if dry_run else 512
+    grid = (n, n, n)
+    t = np.float32(0.0)
+    rhs_args = {"a": np.float32(1.0), "hubble": np.float32(0.5)}
+
+    # the generic step program, cold or warm
+    donate = obs.cache_donation_safe()
+    t_build0 = time.perf_counter()
+    stepper, state, dt = bench.build_preheat_step(
+        grid, fused=False, donate=donate)
+    build_s = time.perf_counter() - t_build0
+    compiled, rec = obs.compile_with_report(
+        stepper._jit_step, state, t, dt, rhs_args,
+        label=f"window_step_{n}^3")
+    t_first0 = time.perf_counter()
+    state = compiled(state, t, dt, rhs_args)
+    bench.sync(state)
+    first_s = time.perf_counter() - t_first0
+
+    # the compile-heavy multigrid program (the round-3 ~365 s item)
+    t_mg0 = time.perf_counter()
+    bench.run_multigrid(n, ncycles=1)
+    mg_ms = (time.perf_counter() - t_mg0) * 1e3
+
+    totals = obs.compile_totals()
+    # anchor at this worker process's own start (module-level T0, set
+    # before the dial and the jax/package imports) — bench.PERF_T0 is
+    # only set when `import bench` runs mid-worker, which would drop
+    # the dial and import phases from the headline number
+    ttfs = time.time() - T0
+    payload = {
+        "phase": phase, "backend": backend, "ndevices": ndev,
+        "grid": n, "dial_s": round(dial_s, 2),
+        "build_s": round(build_s, 2),
+        "step_trace_s": round(rec.trace_seconds, 3),
+        "step_compile_s": round(rec.compile_seconds, 3),
+        "step_cache_hit": rec.cache_hit,
+        "first_dispatch_s": round(first_s, 3),
+        "multigrid_first_cycle_ms": mg_ms,
+        "time_to_first_step_s": round(ttfs, 2),
+        "cache_dir": cache_dir,
+        "cache_hits": totals["cache_hits"],
+        "cache_misses": totals["cache_misses"],
+    }
+
+    # settle the cached-donation question ON HARDWARE: CPU is
+    # measured-unsafe (bench_results/cache_donation_repro.py); if the
+    # TPU runtime triggers too, donated programs must keep bypassing
+    # the cache there as well. The probe runs in BOTH phases: the cold
+    # phase populates the probe program's cache entry (and covers the
+    # weaker same-process configuration), and the WARM phase — a fresh
+    # process whose donated compile is cache-served, the measured
+    # hazard configuration — gives the decisive verdict
+    # (populate_cache_served=True marks it).
+    payload["donation_probe"] = \
+        obs_memory.probe_cache_donation_safety()
+
+    if phase == "cold":
+        store = obs_warmstart.WarmstartStore(ws_dir)
+        meta = store.save(f"window_step_{n}^3", stepper._jit_step,
+                          (state, t, dt, rhs_args))
+        payload["warmstart_fingerprint"] = meta["fingerprint"]
+    else:
+        store = obs_warmstart.WarmstartStore(ws_dir)
+        prog = store.load(f"window_step_{n}^3",
+                          args=(state, t, dt, rhs_args))
+        if prog is not None:
+            with obs.compile_watch("window_warm") as w:
+                out = prog(state, t, dt, rhs_args)
+                bench.sync(out)
+            payload["warmstart"] = {
+                "loaded": True, "fingerprint": prog.fingerprint,
+                "compile_s": round(w.compile_seconds, 3),
+                "cache_hits": w.cache_hits,
+                "cache_misses": w.cache_misses}
+        else:
+            payload["warmstart"] = {"loaded": False}
+    record("cold_start", **payload)
+    return 0
+
+
+def main():
+    p = argparse.ArgumentParser(prog="tpu_window_validation.py")
+    p.add_argument("--legs", default="perf_trace,overlap,lint_tpu,"
+                                     "cold_start",
+                   help="comma-separated legs, priority order")
+    p.add_argument("--dry-run", action="store_true",
+                   help="CPU + tiny grids: rehearse the plumbing")
+    p.add_argument("--budget", type=float, default=2400.0,
+                   help="per-leg wall budget (s)")
+    p.add_argument("--worker", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--phase", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args()
+
+    if args.worker:
+        fn = {"perf_trace": worker_perf_trace,
+              "overlap": worker_overlap,
+              "lint_tpu": worker_lint_tpu}.get(args.worker)
+        if fn is not None:
+            return fn(args.dry_run)
+        if args.worker == "cold_start":
+            return worker_cold_start(args.dry_run, args.phase)
+        print(f"unknown worker {args.worker}", file=sys.stderr)
+        return 2
+
+    dry = ["--dry-run"] if args.dry_run else []
+    for leg in args.legs.split(","):
+        leg = leg.strip()
+        if leg == "cold_start":
+            # two processes: populate (cold), then re-dial (warm) —
+            # the warmed time-to-first-step is the leg's whole point
+            run_leg("cold_start", args.budget,
+                    argv_extra=("--phase", "cold", *dry))
+            run_leg("cold_start", args.budget,
+                    argv_extra=("--phase", "warm", *dry))
+        else:
+            run_leg(leg, args.budget, argv_extra=tuple(dry))
+    hb(f"done; results in {RESULTS}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

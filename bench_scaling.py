@@ -205,6 +205,12 @@ def main():
     profile_dir = None
     if "--profile" in argv:
         profile_dir = argv[argv.index("--profile") + 1]
+    # persistent compilation cache: a weak-scaling sweep re-dials and
+    # recompiles the same per-device program shapes run after run;
+    # cached backend compiles take minutes off the sweep (cold_start
+    # events from the instrumented steppers record the split)
+    from pystella_tpu.obs.memory import ensure_compilation_cache
+    ensure_compilation_cache()
     navail = len(jax.devices())
     if dev_counts is None:
         dev_counts = [d for d in (1, 2, 4, 8, 16, 32, 64) if d <= navail]

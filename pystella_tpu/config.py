@@ -140,6 +140,21 @@ register("PYSTELLA_VMEM_LIMIT_MB", default="100", kind="float",
 register("PYSTELLA_BLOCK_BUDGET_MB", default="24", kind="float",
          help="VMEM budget in MiB that ops.pallas_stencil.choose_blocks "
               "fits the streaming window ring into")
+register("PYSTELLA_COMPILE_CACHE_DIR", default="bench_results/xla_cache",
+         kind="path",
+         help="persistent XLA compilation-cache directory wired by "
+              "obs.memory.ensure_compilation_cache (drivers call it "
+              "before dispatching); relative paths anchor at the "
+              "repository root, not the cwd; ''/'0'/'off'/'none' "
+              "disables (un-wiring any already-set cache) — a "
+              "re-dialed process then pays every backend compile again")
+register("PYSTELLA_WARMSTART_DIR", default=None, kind="path",
+         help="default artifact directory for the AOT warm-start "
+              "store (obs.warmstart): the export/verify CLI and "
+              "bench.py's warm-start leg persist and load matching "
+              "artifacts there, skipping trace+compile for them — "
+              "fingerprint mismatches fall back to the jit path and "
+              "are recorded as warmstart_mismatch events")
 
 # ---------------------------------------------------------------------------
 # driver knobs (bench.py / bench_scaling.py / examples)

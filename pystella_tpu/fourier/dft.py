@@ -240,8 +240,11 @@ class DFT:
         self.sub_k_device = [decomp.axis_array(mu, ki, sharded=(mu != 2))
                              for mu, ki in enumerate(k)]
 
-        self._dft = jax.jit(self._dft_impl)
-        self._idft = jax.jit(self._idft_impl)
+        from pystella_tpu.obs import memory as _obs_memory
+        self._dft = _obs_memory.instrument_jit(
+            jax.jit(self._dft_impl), label="dft.forward")
+        self._idft = _obs_memory.instrument_jit(
+            jax.jit(self._idft_impl), label="dft.inverse")
 
     def shape(self, forward_output=True):
         """Global array shape (reference dft.py:124-133 reports per-rank

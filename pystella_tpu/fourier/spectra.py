@@ -80,7 +80,9 @@ class PowerSpectra:
             b = jnp.broadcast_to(bin_idx, w.shape)
             return b, w
 
-        jitted = jax.jit(weights_impl)
+        from pystella_tpu.obs import memory as _obs_memory
+        jitted = _obs_memory.instrument_jit(
+            jax.jit(weights_impl), label="spectra.weights")
         self._weights = lambda fk, k_power: jitted(
             fk, k_power, self._counts, self._kmags, self._bin_idx)
 

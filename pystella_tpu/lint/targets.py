@@ -20,7 +20,7 @@ import numpy as np
 
 from pystella_tpu.lint.graph import POLICY_F32, GraphTarget
 
-__all__ = ["default_targets", "GRID"]
+__all__ = ["default_targets", "targets_by_name", "GRID"]
 
 #: audited lattice (tiny: the hazards are shape-independent)
 GRID = (16, 16, 16)
@@ -187,6 +187,23 @@ def build_mg_smooth():
 
     fn = jax.jit(smooth)
     return fn, ({"f": f}, {"rho": rho}), {}, None
+
+
+def targets_by_name(names=None):
+    """The audited targets as a name -> :class:`GraphTarget` dict,
+    optionally restricted to ``names`` (unknown names raise). The
+    registry is shared infrastructure now: the IR audit lowers these
+    programs, and ``python -m pystella_tpu.obs.warmstart export``
+    AOT-serializes the very same builds — one definition of "the
+    dispatched step programs" for both."""
+    table = {t.name: t for t in default_targets()}
+    if names is None:
+        return table
+    missing = sorted(set(names) - set(table))
+    if missing:
+        raise KeyError(f"unknown lint target(s) {missing}; "
+                       f"known: {sorted(table)}")
+    return {n: table[n] for n in names}
 
 
 def default_targets():

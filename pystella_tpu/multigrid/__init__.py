@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import memory as _obs_memory
 from pystella_tpu.obs import metrics as _metrics
 from pystella_tpu.obs.scope import trace_scope
 from pystella_tpu.multigrid.relax import (
@@ -172,7 +173,9 @@ class FullApproximationScheme:
             def body(blk):
                 return op.apply_local(blk, pad_fn=decomp.pad_with_halos)
 
-            cached = jax.jit(decomp.shard_map(body, spec, spec))
+            cached = _obs_memory.instrument_jit(
+                jax.jit(decomp.shard_map(body, spec, spec)),
+                label=f"mg.transfer.{type(op).__name__}")
             self._transfer_cache[key] = cached
         return cached
 

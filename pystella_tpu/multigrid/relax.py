@@ -34,6 +34,7 @@ from jax import lax
 
 from pystella_tpu import field as _field
 from pystella_tpu.field import Field, Var, diff, evaluate
+from pystella_tpu.obs import memory as _obs_memory
 from pystella_tpu.obs.scope import trace_scope
 from pystella_tpu.ops.derivs import (
     SecondCenteredDifference, _apply_centered, _shifted)
@@ -263,6 +264,8 @@ class RelaxationBase:
             fn = jax.jit(decomp.shard_map(body, (spec, spec, spec), spec))
         else:
             fn = jax.jit(body)
+        fn = _obs_memory.instrument_jit(
+            fn, label=f"mg.{kind}{tuple(level.grid_shape)}")
         self._compiled[key] = fn
         return fn
 
@@ -417,7 +420,9 @@ class RelaxationBase:
             out = core(fstack, rhostack, aux_args, nu)
             return [out[i] for i in range(len(f_list))]
 
-        fn = jax.jit(entry)
+        fn = _obs_memory.instrument_jit(
+            jax.jit(entry),
+            label=f"mg.pallas_{kind}{tuple(level.grid_shape)}")
         self._compiled[key] = fn
         return fn
 

@@ -205,9 +205,15 @@ def _run_local(op, x, decomp):
             def body(blk):
                 return op.apply_local(blk, pad_fn=decomp.pad_with_halos)
 
-            fn = cache[key] = jax.jit(decomp.shard_map(body, spec, spec))
+            from pystella_tpu.obs import memory as _obs_memory
+            fn = cache[key] = _obs_memory.instrument_jit(
+                jax.jit(decomp.shard_map(body, spec, spec)),
+                label=f"mg.transfer.{type(op).__name__}.sharded")
         return fn(x)
     fn = cache.get("local")
     if fn is None:
-        fn = cache["local"] = jax.jit(lambda a: op.apply_local(a))
+        from pystella_tpu.obs import memory as _obs_memory
+        fn = cache["local"] = _obs_memory.instrument_jit(
+            jax.jit(lambda a: op.apply_local(a)),
+            label=f"mg.transfer.{type(op).__name__}.local")
     return fn(x)

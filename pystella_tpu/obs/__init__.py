@@ -72,12 +72,17 @@ from pystella_tpu.obs.scope import (
     has_scope, lowered_scopes, register_scope, registered_scopes,
     trace_scope, traced)
 from pystella_tpu.obs.memory import (
-    CompileRecord, compile_with_report, device_memory_report,
-    device_memory_stats)
-# obs.gate is deliberately NOT imported here: its primary entry point is
-# ``python -m pystella_tpu.obs.gate``, and runpy warns when the module
-# is already in sys.modules at -m execution time. Import it explicitly
-# (``from pystella_tpu.obs import gate``) for programmatic use.
+    CompileRecord, cache_bypass, cache_donation_safe, compile_totals,
+    compile_watch, compile_with_report, device_memory_report,
+    device_memory_stats, ensure_compilation_cache, instrument_jit,
+    probe_cache_donation_safety, program_fingerprint, runtime_versions,
+    signature_fingerprint)
+# obs.gate and obs.warmstart are deliberately NOT imported here: their
+# primary entry points are ``python -m pystella_tpu.obs.gate`` /
+# ``... .obs.warmstart``, and runpy warns when the module is already in
+# sys.modules at -m execution time. Import them explicitly
+# (``from pystella_tpu.obs import gate, warmstart``) for programmatic
+# use.
 from pystella_tpu.obs import forensics, ledger, sentinel, trace
 from pystella_tpu.obs.ledger import PerfLedger, environment_fingerprint
 from pystella_tpu.obs.trace import scope_durations, summarize_trace
@@ -91,7 +96,10 @@ __all__ = [
     "counter", "gauge", "timer", "registry",
     "trace_scope", "traced", "lowered_scopes", "has_scope",
     "register_scope", "registered_scopes",
-    "CompileRecord", "compile_with_report",
+    "CompileRecord", "compile_with_report", "compile_watch",
+    "compile_totals", "instrument_jit", "ensure_compilation_cache",
+    "cache_bypass", "cache_donation_safe", "probe_cache_donation_safety",
+    "program_fingerprint", "signature_fingerprint", "runtime_versions",
     "device_memory_report", "device_memory_stats",
     "trace", "ledger", "sentinel", "forensics",
     "PerfLedger", "environment_fingerprint",

@@ -12,13 +12,16 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       the threshold AND more than ``mad_k`` robust sigmas of noise — or
       a NUMERICS regression: a sentinel invariant's drift slope exceeds
       ``drift_factor`` x the baseline's (constraint drift worse than
-      baseline fails CI the same way a slow step does)
+      baseline fails CI the same way a slow step does) — or a
+      COLD-START regression: time-to-first-step exceeds the baseline's
+      by both ``cold_start_factor`` and ``cold_start_floor`` seconds
 2     invalid evidence: the contamination detector flagged the run
       (outlier burst / bimodal step times — the round-5 concurrent-probe
       signature), the report has no step samples, the run DIVERGED (a
       sentinel trip in the ``numerics`` section — broken step times
-      prove nothing), or baseline and current were measured on
-      different hardware
+      prove nothing), the report CLAIMS warm start over AOT artifacts
+      whose fingerprints mismatch the live compiler stack, or baseline
+      and current were measured on different hardware
 3     missing or unreadable baseline (suppress with
       ``--allow-missing-baseline``, e.g. on a branch's first run)
 4     unreadable current report / bad usage
@@ -184,7 +187,8 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     allow_env_mismatch=False,
                     check_contamination="auto", check_numerics=True,
                     drift_factor=10.0, drift_floor=1e-12,
-                    check_lint=True):
+                    check_lint=True, check_cold_start=True,
+                    cold_start_factor=1.5, cold_start_floor=5.0):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -204,6 +208,14 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     to be off the fast path, so they prove nothing about the code as
     designed. A baseline with lint coverage that the current run lost
     degrades to a warning.
+
+    ``check_cold_start`` (default on): a report whose ``cold_start``
+    section *claims* warm start while any loaded artifact's fingerprint
+    mismatches is invalid evidence (exit 2 — the run did not execute
+    the programs it says it did), and a time-to-first-step more than
+    ``cold_start_factor`` x the baseline's AND ``cold_start_floor``
+    seconds above it fails the gate like a step-time regression (exit
+    1) — cold-start time IS a production metric.
 
     ``check_numerics`` (default on) extends the gate beyond step times:
     a run whose ``numerics`` section records a sentinel trip is invalid
@@ -243,6 +255,46 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
             verdict["warnings"].append(
                 "lint: baseline carried a static-analysis verdict but "
                 "the current run has none — lint coverage was lost")
+
+    if check_cold_start:
+        ws = (current.get("cold_start") or {}).get("warmstart") or {}
+        if ws.get("claimed"):
+            bad = [a for a in ws.get("artifacts") or []
+                   if a.get("match") is False
+                   or a.get("bitexact") is False]
+            if bad:
+                # the report says it ran AOT-loaded programs whose
+                # fingerprints do not match the live compiler stack —
+                # or whose outputs diverged from the jit reference (the
+                # cached-donated-executable failure mode): whatever it
+                # measured, it was not the warm path it claims —
+                # neither pass nor fail
+                verdict.update(ok=False, exit_code=2)
+                for a in bad:
+                    if a.get("bitexact") is False:
+                        verdict["reasons"].append(
+                            "invalid_evidence: report claims warm "
+                            "start but the loaded artifact computed "
+                            "different results than the jit path: "
+                            f"{a.get('label')!r} "
+                            f"({a.get('fingerprint')})")
+                    else:
+                        verdict["reasons"].append(
+                            "invalid_evidence: report claims warm "
+                            "start but the loaded artifact's "
+                            "fingerprint mismatches: "
+                            f"{a.get('label')!r} "
+                            f"({a.get('reason') or a.get('fingerprint')})")
+                return verdict
+        # refused-stale-artifact fallbacks are HONEST (the mismatched
+        # program was never run warm — the driver took the cold jit
+        # path by design), so they warn rather than refuse: the
+        # operator likely wants to re-export
+        for a in (ws.get("fallbacks") or [])[:3]:
+            verdict["warnings"].append(
+                "warmstart: stale artifact refused, cold fallback "
+                f"taken: {a.get('label')!r} "
+                f"({a.get('reason') or a.get('fingerprint')})")
 
     cur_num = current.get("numerics") or {}
     if check_numerics and cur_num.get("diverged"):
@@ -353,7 +405,60 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
         _compare_numerics(verdict, baseline, current,
                           drift_factor=drift_factor,
                           drift_floor=drift_floor)
+    if check_cold_start:
+        _compare_cold_start(verdict, baseline, current,
+                            factor=cold_start_factor,
+                            floor_s=cold_start_floor)
     return verdict
+
+
+def _compare_cold_start(verdict, baseline, current, factor=1.5,
+                        floor_s=5.0):
+    """Time-to-first-step comparison (mutates ``verdict`` in place): a
+    regression must clear BOTH the relative factor and the absolute
+    floor — cold start on a small smoke run jitters by seconds
+    (interpreter + jax import), so a pure ratio would flap. Coverage
+    loss (baseline had a ``cold_start`` section, current does not)
+    degrades to a warning."""
+    bcs = (baseline or {}).get("cold_start") or {}
+    ccs = current.get("cold_start") or {}
+    b = bcs.get("time_to_first_step_s")
+    c = ccs.get("time_to_first_step_s")
+    if bcs and not ccs:
+        verdict["warnings"].append(
+            "cold_start: baseline carried a cold-start section but the "
+            "current run has none — cold-start coverage was lost")
+        return
+    if (isinstance(b, (int, float)) and b > 0
+            and not isinstance(c, (int, float))):
+        # the current run has compile telemetry but never measured a
+        # time-to-first-step (driver crashed pre-step, or a custom
+        # driver without the cold_start event) — the metric the
+        # baseline gated on is GONE, which must be visible, not a
+        # silent pass
+        verdict["warnings"].append(
+            "cold_start: baseline carried a time-to-first-step but the "
+            "current run's cold_start section has none — cold-start "
+            "coverage was lost")
+        return
+    if not isinstance(b, (int, float)) or not isinstance(
+            c, (int, float)) or b <= 0:
+        return
+    verdict["cold_start"] = {
+        "baseline_s": b, "current_s": c,
+        "factor": factor, "floor_s": floor_s,
+    }
+    if c > b * factor and c - b > floor_s:
+        verdict.update(ok=False, exit_code=max(verdict["exit_code"], 1))
+        verdict["reasons"].append(
+            f"cold-start regression: time-to-first-step {c:.1f} s vs "
+            f"baseline {b:.1f} s (allowed factor {factor:g}, floor "
+            f"{floor_s:g} s) — check the compile table and cache hit "
+            "rate in the report's cold_start section")
+    elif b > c * factor and b - c > floor_s:
+        verdict["warnings"].append(
+            f"cold-start improvement: {c:.1f} s vs baseline {b:.1f} s "
+            "— consider refreshing the baseline")
 
 
 def _compare_numerics(verdict, baseline, current, drift_factor=10.0,
@@ -444,6 +549,18 @@ def main(argv=None):
                    help="numerics: drift-per-step floor applied to both "
                         "sides, so a ~zero baseline slope cannot make "
                         "any finite drift a regression (default 1e-12)")
+    p.add_argument("--cold-start-factor", type=float, default=1.5,
+                   help="cold start: allowed multiple of the baseline's "
+                        "time-to-first-step before the gate fails "
+                        "(default 1.5)")
+    p.add_argument("--cold-start-floor", type=float, default=5.0,
+                   help="cold start: absolute seconds a regression must "
+                        "also exceed (default 5; small-run cold starts "
+                        "jitter by whole seconds)")
+    p.add_argument("--no-cold-start", action="store_true",
+                   help="skip the cold-start checks (time-to-first-step "
+                        "regression, warm-start fingerprint-mismatch "
+                        "refusal)")
     p.add_argument("--no-numerics", action="store_true",
                    help="skip the numerics checks (invariant drift, "
                         "diverged-run invalidation)")
@@ -485,7 +602,10 @@ def main(argv=None):
         check_contamination=args.check_contamination,
         check_numerics=not args.no_numerics,
         drift_factor=args.drift_factor, drift_floor=args.drift_floor,
-        check_lint=not args.no_lint)
+        check_lint=not args.no_lint,
+        check_cold_start=not args.no_cold_start,
+        cold_start_factor=args.cold_start_factor,
+        cold_start_floor=args.cold_start_floor)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

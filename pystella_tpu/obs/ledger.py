@@ -79,6 +79,22 @@ def _version_of(dist):
         return None
 
 
+def runtime_versions():
+    """The jax/jaxlib/libtpu version triple — the compiler stack that
+    keys both perf-report comparability (this module's environment
+    fingerprint) and cached/AOT program staleness
+    (``obs.memory`` bakes it into every program fingerprint, via this
+    one definition so the two can never diverge). Stdlib-only:
+    resolved from installed-distribution metadata, no jax import."""
+    return {
+        "jax": _version_of("jax"),
+        "jaxlib": _version_of("jaxlib"),
+        # a libtpu bump changes the generated code: cached/AOT programs
+        # keyed without it would silently serve stale executables
+        "libtpu": _version_of("libtpu") or _version_of("libtpu-nightly"),
+    }
+
+
 #: env-var name substrings that make an XLA/libtpu flag relevant to the
 #: fingerprint: async-collective and latency-hiding-scheduler toggles
 #: change what a step-time comparison means (the overlapped halo path
@@ -118,8 +134,7 @@ def environment_fingerprint():
     are ``None`` when jax is not loaded."""
     env = {
         "python": _platform.python_version(),
-        "jax": _version_of("jax"),
-        "jaxlib": _version_of("jaxlib"),
+        **runtime_versions(),
         "hostname": socket.gethostname(),
         "platform": None,
         "device_kind": None,
@@ -218,6 +233,10 @@ class PerfLedger:
         self.forensic_bundles = []      # bundle paths written this run
         self.lint = None                # lint-event summary (see lint())
         self.donated_bytes = None       # aliased bytes in the step compile
+        self.cold_start_meta = {}       # cold_start-event payload
+        self.cache_info = {}            # compile_cache-event payload
+        self.warmstart_loads = []       # warmstart_load payloads
+        self.warmstart_mismatches = []  # warmstart_mismatch payloads
 
     # -- ingestion ---------------------------------------------------------
 
@@ -292,6 +311,18 @@ class PerfLedger:
                 # report's `lint` section, and the gate's refusal
                 # trigger when the run's lint failed
                 led.lint = data
+            elif kind == "cold_start":
+                # driver-emitted time-to-first-step breakdown (import /
+                # build / trace / compile / first dispatch)
+                led.cold_start_meta = data
+            elif kind == "compile_cache":
+                # persistent-compilation-cache wiring
+                # (obs.memory.ensure_compilation_cache)
+                led.cache_info = data
+            elif kind == "warmstart_load":
+                led.warmstart_loads.append(data)
+            elif kind == "warmstart_mismatch":
+                led.warmstart_mismatches.append(data)
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -421,6 +452,83 @@ class PerfLedger:
             "achieved_ici_gbps": ici,
         }
 
+    def cold_start(self):
+        """The cold-start summary: time-to-first-step breakdown (from
+        the driver's ``cold_start`` event), the per-program compile
+        table (from ``compile`` events — trace vs backend-compile
+        seconds, fingerprint, persistent-cache attribution), cache
+        wiring and hit rate, and the warm-start story (artifacts
+        loaded, fingerprint mismatches). ``None`` when the run carried
+        no compile telemetry at all.
+
+        Nested instrumented dispatches each report their own row, so
+        the table's per-row seconds may overlap (an outer chunk's row
+        includes its inner kernels'); the headline phase numbers come
+        from the driver's own breakdown, not a sum of rows."""
+        if not (self.cold_start_meta or self.compile_records
+                or self.cache_info or self.warmstart_loads
+                or self.warmstart_mismatches):
+            return None
+        compiles = []
+        hits = misses = 0
+        for r in self.compile_records:
+            h = int(r.get("cache_hits") or 0)
+            m = int(r.get("cache_misses") or 0)
+            hits += h
+            misses += m
+            compiles.append({
+                "label": r.get("label"),
+                "fingerprint": r.get("fingerprint"),
+                "fingerprint_kind": r.get("fingerprint_kind"),
+                "trace_s": float(r.get("trace_seconds") or 0.0),
+                "compile_s": float(r.get("compile_seconds") or 0.0),
+                "cache_hit": r.get("cache_hit"),
+                "source": r.get("source"),
+            })
+        compiles.sort(key=lambda c: -(c["trace_s"] + c["compile_s"]))
+        cache = dict(self.cold_start_meta.get("cache") or {})
+        cache.setdefault("dir", self.cache_info.get("dir"))
+        cache.setdefault("hits", hits)
+        cache.setdefault("misses", misses)
+        tot = (cache.get("hits") or 0) + (cache.get("misses") or 0)
+        cache["hit_rate"] = (cache.get("hits", 0) / tot) if tot else None
+        warm = self.cold_start_meta.get("warmstart") or {}
+        artifacts = list(warm.get("artifacts") or [])
+        seen = {(a.get("label"), a.get("fingerprint"))
+                for a in artifacts}
+        for w in self.warmstart_loads:
+            key = (w.get("label"), w.get("fingerprint"))
+            if key not in seen:
+                seen.add(key)
+                artifacts.append({"label": w.get("label"),
+                                  "fingerprint": w.get("fingerprint"),
+                                  "match": True})
+        # a warmstart_mismatch event means the store REFUSED an
+        # artifact and the driver took the cold jit path — an honest
+        # fallback, not a warm-path claim, so it must not land in
+        # `artifacts` where the gate would refuse the run as invalid
+        # evidence; only driver-declared artifacts and actual loads
+        # belong there
+        fallbacks = [{"label": w.get("label"),
+                      "fingerprint": w.get("fingerprint"),
+                      "reason": w.get("reason")}
+                     for w in self.warmstart_mismatches]
+        warmstart = {
+            "claimed": bool(warm.get("claimed",
+                                     bool(self.warmstart_loads))),
+            "artifacts": artifacts,
+            "fallbacks": fallbacks,
+        }
+        return {
+            "time_to_first_step_s":
+                self.cold_start_meta.get("time_to_first_step_s"),
+            "phases": self.cold_start_meta.get("phases") or {},
+            "compiles": compiles[:64],
+            "n_compile_events": len(compiles),
+            "cache": cache,
+            "warmstart": warmstart,
+        }
+
     def numerics(self):
         """The numerics-observability summary (sentinel health): per
         invariant the first/last values and the least-squares
@@ -483,6 +591,7 @@ class PerfLedger:
             },
             "roofline": self.roofline(),
             "overlap": self.overlap_summary(),
+            "cold_start": self.cold_start(),
             "numerics": self.numerics(),
             "lint": self.lint,
             "scopes": self.scopes,
@@ -541,8 +650,9 @@ def render_markdown(rep):
         "",
         "## Environment",
         "",
-        f"- jax {env.get('jax')} / jaxlib {env.get('jaxlib')}, "
-        f"python {env.get('python')}",
+        f"- jax {env.get('jax')} / jaxlib {env.get('jaxlib')}"
+        + (f" / libtpu {env['libtpu']}" if env.get("libtpu") else "")
+        + f", python {env.get('python')}",
         f"- platform `{env.get('platform')}`, device kind "
         f"`{env.get('device_kind')}`, {env.get('num_devices')} device(s), "
         f"{env.get('num_processes')} process(es), "
@@ -613,6 +723,66 @@ def render_markdown(rep):
                 f"overlapped call(s) -> achieved "
                 f"~{_fmt(ov.get('achieved_ici_gbps'))} GB/s ICI "
                 "(per-device estimate)")
+        lines.append("")
+    cs = rep.get("cold_start")
+    if cs:
+        lines += ["## Cold start", ""]
+        ph = cs.get("phases") or {}
+        # drivers report different phase sets (bench smoke: import/
+        # build, TPU payload: dial, examples: setup) — render whatever
+        # this run measured, in pipeline order, instead of a fixed
+        # key list that dashes out the dial/setup share
+        order = ("import_s", "dial_s", "setup_s", "build_s", "trace_s",
+                 "compile_s", "first_dispatch_s")
+        keys = ([k for k in order if k in ph]
+                + sorted(k for k in ph if k not in order))
+        parts = ", ".join(
+            f"{k[:-2].replace('_', ' ') if k.endswith('_s') else k} "
+            f"{_fmt(ph.get(k))}" for k in keys)
+        lines.append(
+            f"- time to first step: "
+            f"{_fmt(cs.get('time_to_first_step_s'))} s"
+            + (f" ({parts} s)" if parts else ""))
+        ca = cs.get("cache") or {}
+        lines.append(
+            f"- compilation cache: "
+            + (f"`{ca.get('dir')}` — {_fmt(ca.get('hits'), '.0f', '0')} "
+               f"hit(s) / {_fmt(ca.get('misses'), '.0f', '0')} miss(es)"
+               f" (hit rate {_fmt(ca.get('hit_rate'), '.1%')})"
+               if ca.get("dir") else "not wired "
+               "(set PYSTELLA_COMPILE_CACHE_DIR)"))
+        ws = cs.get("warmstart") or {}
+        if ws.get("claimed"):
+            arts = ws.get("artifacts") or []
+            ok = sum(1 for a in arts if a.get("match"))
+            bad = [a for a in arts if a.get("match") is False]
+            lines.append(
+                f"- warm start: {ok} AOT artifact(s) loaded"
+                + (f", **{len(bad)} fingerprint mismatch(es)**"
+                   if bad else ""))
+            for a in bad[:5]:
+                lines.append(f"  - `{a.get('label')}`: "
+                             f"{a.get('reason') or 'mismatch'}")
+        falls = ws.get("fallbacks") or []
+        if falls:
+            lines.append(
+                f"- {len(falls)} stale artifact(s) refused (honest "
+                "cold fallback)")
+            for a in falls[:5]:
+                lines.append(f"  - `{a.get('label')}`: "
+                             f"{a.get('reason') or 'mismatch'}")
+        compiles = cs.get("compiles") or []
+        if compiles:
+            lines += ["", "| program | trace s | compile s | cache |",
+                      "|---|---|---|---|"]
+            for c in compiles[:12]:
+                hit = c.get("cache_hit")
+                tag = "hit" if hit else ("miss" if hit is False else "—")
+                lines.append(
+                    f"| `{c.get('label')}` | {_fmt(c.get('trace_s'))} "
+                    f"| {_fmt(c.get('compile_s'))} | {tag} |")
+            if len(compiles) > 12:
+                lines.append(f"| … {len(compiles) - 12} more | | | |")
         lines.append("")
     nm = rep.get("numerics")
     if nm:

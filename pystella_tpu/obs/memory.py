@@ -1,22 +1,45 @@
 """Compile-time and device-memory instrumentation.
 
-Two kinds of evidence, both recorded into the event log:
+Three kinds of evidence, all recorded into the event log:
 
-- :func:`compile_with_report` — ahead-of-time compile of a jitted
-  computation, timing the compile and extracting XLA's
-  ``memory_analysis()`` byte counts (arguments, outputs, temporaries,
-  generated code). The peak-HBM estimate is exactly the number that
-  would have caught round 5's 183 MB overshoot *before* the allocator
-  rejected the 512^3 GW step: ``rec.peak_bytes`` vs the chip's HBM.
+- the **compile ledger** — every jit/AOT compile the package dispatches
+  routes through here. :func:`compile_with_report` is the explicit
+  ahead-of-time path (splitting *trace* seconds — Python tracing +
+  StableHLO lowering — from *backend-compile* seconds, extracting XLA's
+  ``memory_analysis()`` byte counts, and fingerprinting the program);
+  :func:`instrument_jit` wraps the package's internal ``jax.jit``
+  objects so a compile triggered by a first dispatch is attributed to a
+  stable label (``step.LowStorageRK54``, ``fused.multi_step[10]``,
+  ``mg.smooth``...) via jax's monitoring hooks instead of vanishing
+  into startup time. Each observed compile emits a ``kind="compile"``
+  event carrying the trace/compile split, a program fingerprint, and
+  persistent-cache hit/miss attribution — the raw material of the perf
+  ledger's ``cold_start`` section.
+- :func:`ensure_compilation_cache` — wires jax's persistent
+  compilation cache (``jax_compilation_cache_dir``) to the registered
+  ``PYSTELLA_COMPILE_CACHE_DIR``, so a process that re-dials a device
+  pays XLA's backend compile once per program *ever*, not once per
+  process. Hit/miss counts are read back through the same monitoring
+  hooks.
 - :func:`device_memory_report` — live allocator statistics
   (``Device.memory_stats()``: bytes in use, peak, limit). TPU backends
   populate these; CPU returns ``None`` and the report degrades to a
   no-op instead of raising, so instrumented drivers run everywhere.
+
+The peak-HBM estimate in a :class:`CompileRecord` is exactly the number
+that would have caught round 5's 183 MB overshoot *before* the
+allocator rejected the 512^3 GW step: ``rec.peak_bytes`` vs the chip's
+HBM. The trace/compile split is the number that would have caught
+round 3's ~365 s multigrid cold start — and now does.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import os
+import threading
 import time
 
 import jax
@@ -24,22 +47,283 @@ import jax
 from pystella_tpu.obs import events as _events
 from pystella_tpu.obs import metrics as _metrics
 
-__all__ = ["CompileRecord", "compile_with_report",
-           "device_memory_stats", "device_memory_report"]
+__all__ = ["CompileRecord", "compile_with_report", "compile_watch",
+           "instrument_jit", "InstrumentedJit", "compile_totals",
+           "ensure_compilation_cache", "cache_donation_safe",
+           "should_bypass_cache",
+           "cache_bypass", "probe_cache_donation_safety",
+           "program_fingerprint", "signature_fingerprint",
+           "runtime_versions", "device_memory_stats",
+           "device_memory_report"]
 
+
+# ---------------------------------------------------------------------------
+# jax monitoring bridge: trace/compile durations + persistent-cache events
+# ---------------------------------------------------------------------------
+
+#: monitoring events that measure Python-side program construction
+#: (jaxpr tracing and StableHLO lowering — work a warm AOT start skips)
+_TRACE_EVENTS = ("/jax/core/compile/jaxpr_trace_duration",
+                 "/jax/core/compile/jaxpr_to_mlir_module_duration")
+#: the XLA backend compile itself (work the persistent cache skips)
+_BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
+#: persistent compilation cache outcomes
+_CACHE_EVENTS = {"/jax/compilation_cache/cache_hits": "cache_hits",
+                 "/jax/compilation_cache/cache_misses": "cache_misses"}
+
+_totals_lock = threading.Lock()
+_totals = {"trace_s": 0.0, "compile_s": 0.0,
+           "cache_hits": 0, "cache_misses": 0}
+_watchers = threading.local()
+_listeners_installed = False
+_install_lock = threading.Lock()
+
+
+def _watcher_stack():
+    stack = getattr(_watchers, "stack", None)
+    if stack is None:
+        stack = _watchers.stack = []
+    return stack
+
+
+def _on_duration(event, duration, **kwargs):
+    if event in _TRACE_EVENTS:
+        key = "trace_s"
+    elif event == _BACKEND_EVENT:
+        key = "compile_s"
+    else:
+        return
+    with _totals_lock:
+        _totals[key] += float(duration)
+    for w in _watcher_stack():
+        w._add(key, float(duration))
+
+
+def _on_event(event, **kwargs):
+    key = _CACHE_EVENTS.get(event)
+    if key is None:
+        return
+    with _totals_lock:
+        _totals[key] += 1
+    for w in _watcher_stack():
+        w._add(key, 1)
+
+
+def _install_jax_listeners():
+    """Register the monitoring listeners (idempotent; thread-safe).
+    jax invokes them synchronously on the compiling thread, which is
+    what lets a :class:`compile_watch` attribute activity to the
+    program label whose dispatch triggered it."""
+    global _listeners_installed
+    if _listeners_installed:
+        return
+    with _install_lock:
+        if _listeners_installed:
+            return
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+        _listeners_installed = True
+
+
+def compile_totals():
+    """Process-wide accumulated compile activity since the listeners
+    were installed: ``{trace_s, compile_s, cache_hits, cache_misses}``.
+    The denominators of a cold-start story — how much of startup went
+    to building programs vs running them."""
+    _install_jax_listeners()
+    with _totals_lock:
+        return dict(_totals)
+
+
+class compile_watch:
+    """Attribute jax compile activity inside a ``with`` block to a
+    label. Cheap enough to wrap every dispatch (one list append/pop and
+    four float adds per *compile*, nothing per cached call)::
+
+        with compile_watch("mg.smooth") as w:
+            out = fn(*args)
+        if w.compiled:
+            ...  # w.trace_seconds / w.compile_seconds / w.cache_hits
+
+    Nested watches each observe the same activity (an outer driver-level
+    watch sees the sum of everything its inner calls compiled).
+    """
+
+    def __init__(self, label=None):
+        self.label = label
+        self.trace_seconds = 0.0
+        self.compile_seconds = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    def _add(self, key, val):
+        if key == "trace_s":
+            self.trace_seconds += val
+        elif key == "compile_s":
+            self.compile_seconds += val
+        elif key == "cache_hits":
+            self.cache_hits += val
+        elif key == "cache_misses":
+            self.cache_misses += val
+
+    @property
+    def compiled(self):
+        """Did any program construction happen inside the block?"""
+        return (self.trace_seconds > 0.0 or self.compile_seconds > 0.0
+                or self.cache_hits > 0 or self.cache_misses > 0)
+
+    def __enter__(self):
+        _install_jax_listeners()
+        _watcher_stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            _watcher_stack().remove(self)
+        except ValueError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# program fingerprints
+# ---------------------------------------------------------------------------
+
+_versions_cache = None
+
+
+def runtime_versions():
+    """The compiler-stack versions that invalidate cached/AOT programs:
+    a jax/jaxlib (or libtpu) bump must never silently load a stale
+    executable, so these are baked into every program fingerprint and
+    warm-start artifact. One definition, shared with the perf report's
+    environment fingerprint (``obs.ledger.runtime_versions``).
+    (Memoized — ``importlib.metadata`` scans dist-info, and
+    fingerprints are computed per observed compile.)"""
+    global _versions_cache
+    if _versions_cache is None:
+        from pystella_tpu.obs import ledger as _ledger
+        _versions_cache = _ledger.runtime_versions()
+    return dict(_versions_cache)
+
+
+def _leaf_signature(leaf):
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    sig = [list(shape) if shape is not None else None,
+           str(dtype) if dtype is not None else type(leaf).__name__]
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None:
+        try:
+            sig.append(str(sharding.spec))
+            mesh = getattr(sharding, "mesh", None)
+            if mesh is not None:
+                sig.append([list(mesh.shape.values()),
+                            list(mesh.shape.keys()),
+                            str(getattr(mesh.devices.flat[0],
+                                        "device_kind", ""))])
+        except Exception:
+            pass
+    return sig
+
+
+def fingerprint_components(label="", args=None, kwargs=None):
+    """The JSON-safe identity a program fingerprint hashes: label,
+    per-leaf shape/dtype/sharding/mesh signature, compiler-stack
+    versions (:func:`runtime_versions`), and the scheduler-relevant
+    flag fingerprint (``parallel.overlap.flags_fingerprint`` — the
+    same flags the perf-report environment records, because they change
+    the compiled schedule)."""
+    from pystella_tpu.parallel.overlap import flags_fingerprint
+    leaves = []
+    if args is not None or kwargs is not None:
+        leaves = [_leaf_signature(leaf) for leaf in
+                  jax.tree_util.tree_leaves((args or (), kwargs or {}))]
+    return {"label": str(label),
+            "avals": leaves,
+            "versions": runtime_versions(),
+            "flags": flags_fingerprint()}
+
+
+def _digest(components):
+    blob = json.dumps(components, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def signature_fingerprint(label="", args=None, kwargs=None):
+    """Cheap fingerprint from the call signature only (no re-lowering:
+    safe to compute on a hot dispatch path). Returns
+    ``(digest, components)``."""
+    comp = fingerprint_components(label, args, kwargs)
+    return _digest(comp), comp
+
+
+def program_fingerprint(lowered=None, *, label="", args=None,
+                        kwargs=None, text=None):
+    """Full program fingerprint: the signature components plus a
+    sha256 of the lowered StableHLO module (``lowered.as_text()`` or an
+    explicit ``text``). Two programs share a fingerprint exactly when
+    the compiler would rebuild the same executable for them — the key
+    warm-start artifacts and the compile ledger are indexed by.
+    Returns ``(digest, components)``."""
+    comp = fingerprint_components(label, args, kwargs)
+    if text is None and lowered is not None:
+        text = lowered.as_text()
+    if text is not None:
+        comp["module_sha256"] = hashlib.sha256(
+            text.encode() if isinstance(text, str) else text).hexdigest()
+    return _digest(comp), comp
+
+
+# ---------------------------------------------------------------------------
+# compile records + the AOT path
+# ---------------------------------------------------------------------------
 
 @dataclasses.dataclass
 class CompileRecord:
     """One computation's compile cost and memory footprint (byte fields
-    are ``None`` when the backend provides no memory analysis)."""
+    are ``None`` when the backend provides no memory analysis).
+
+    ``trace_seconds`` is Python-side program construction (jaxpr trace
+    + StableHLO lowering — the cost an AOT warm start skips);
+    ``compile_seconds`` is the XLA backend-compile span (the cost the
+    persistent compilation cache collapses — on a cache HIT the span
+    still ticks for retrieval + executable deserialization, so judge
+    "did it compile?" by ``cache_hit``, not by seconds alone). Older
+    events carried the two lumped into ``compile_seconds``; consumers
+    treat a missing ``trace_seconds`` as 0."""
 
     label: str
     compile_seconds: float
+    trace_seconds: float = 0.0
+    #: MLIR text serialization for the fingerprint/donation scan —
+    #: measurement overhead kept OUT of both spans above, but visible
+    #: here so large-module hashing cost cannot hide
+    serialize_seconds: float = 0.0
+    fingerprint: str | None = None
+    fingerprint_kind: str | None = None
+    cache_hits: int = 0
+    cache_misses: int = 0
     argument_bytes: int | None = None
     output_bytes: int | None = None
     temp_bytes: int | None = None
     alias_bytes: int | None = None
     generated_code_bytes: int | None = None
+
+    @property
+    def total_seconds(self):
+        """Trace + backend compile: the whole cost of getting this
+        program from Python to an executable."""
+        return self.trace_seconds + self.compile_seconds
+
+    @property
+    def cache_hit(self):
+        """Did the persistent cache serve this compile? (``None`` when
+        the cache saw no request — cache disabled or nothing reached
+        the backend.)"""
+        if self.cache_hits == 0 and self.cache_misses == 0:
+            return None
+        return self.cache_misses == 0
 
     @property
     def peak_bytes(self):
@@ -54,6 +338,8 @@ class CompileRecord:
     def asdict(self):
         d = dataclasses.asdict(self)
         d["peak_bytes"] = self.peak_bytes
+        d["total_seconds"] = self.total_seconds
+        d["cache_hit"] = self.cache_hit
         return d
 
 
@@ -75,32 +361,421 @@ def _memory_analysis(compiled):
             if hasattr(ma, attr)}
 
 
+def _record_compile_metrics(rec):
+    _metrics.counter("compiles").inc()
+    _metrics.timer("compile_s").observe(rec.compile_seconds)
+    _metrics.timer("trace_s").observe(rec.trace_seconds)
+    if rec.cache_hits:
+        _metrics.counter("compile_cache_hits").inc(rec.cache_hits)
+    if rec.cache_misses:
+        _metrics.counter("compile_cache_misses").inc(rec.cache_misses)
+
+
 def compile_with_report(fn, *args, label=None, log=None, step=None,
-                        **kwargs):
+                        fingerprint=True, **kwargs):
     """AOT-compile ``fn(*args, **kwargs)`` and report the cost.
 
     :arg fn: a jitted callable (``jax.jit`` result — fused steppers'
         ``_jit_step`` qualifies) or a plain function (jitted here).
+    :arg fingerprint: compute the full lowered-module fingerprint
+        (default; pass ``False`` to skip hashing a very large module).
     :returns: ``(compiled, record)`` — the executable (call it directly
         to avoid a second compile) and the :class:`CompileRecord`.
 
+    The record splits ``trace_seconds`` (the ``lower()`` wall time:
+    jaxpr tracing + StableHLO lowering, pure Python-side cost) from
+    ``compile_seconds`` (the ``compile()`` wall time: XLA's backend
+    compile, which the persistent cache can satisfy — the record's
+    ``cache_hits``/``cache_misses`` say whether it did).
+
     Side effects: a ``kind="compile"`` event on ``log`` (default: the
-    process event log), a ``compiles`` counter increment, and a
-    ``compile_s`` timer observation in the default metrics registry.
+    process event log), a ``compiles`` counter increment, and
+    ``compile_s``/``trace_s`` timer observations in the default metrics
+    registry.
     """
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
     label = label or getattr(fn, "__name__", None) or repr(fn)
-    t0 = time.perf_counter()
-    compiled = jitted.lower(*args, **kwargs).compile()
-    secs = time.perf_counter() - t0
-    rec = CompileRecord(label=label, compile_seconds=secs,
+    _install_jax_listeners()
+    with compile_watch(label) as w:
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*args, **kwargs)
+        t1 = time.perf_counter()
+        # MLIR serialization is Python-side measurement overhead —
+        # keep it out of BOTH reported spans (a cache-hit
+        # compile_seconds must show retrieval cost, not as_text()),
+        # and skip it entirely unless the fingerprint or the donation
+        # check below actually needs the text
+        text = None
+        if fingerprint or (_cache_configured()
+                           and not cache_donation_safe()):
+            text = lowered.as_text()
+        # a DONATED program must not be served from a deserialized
+        # cache entry on backends where that corrupts repeat calls
+        # (cache_donation_safe docstring): compile it fresh instead
+        donated = (text is not None
+                   and any(m in text for m in _DONATION_MARKERS))
+        bypass = should_bypass_cache(donated)
+        tc = time.perf_counter()
+        if bypass:
+            with cache_bypass():
+                compiled = lowered.compile()
+        else:
+            compiled = lowered.compile()
+        t2 = time.perf_counter()
+    fp = kind = None
+    if fingerprint:
+        fp, _ = program_fingerprint(text=text, label=label, args=args,
+                                    kwargs=kwargs)
+        kind = "lowered"
+    rec = CompileRecord(label=label, trace_seconds=t1 - t0,
+                        compile_seconds=t2 - tc,
+                        serialize_seconds=tc - t1,
+                        fingerprint=fp, fingerprint_kind=kind,
+                        cache_hits=w.cache_hits,
+                        cache_misses=w.cache_misses,
                         **_memory_analysis(compiled))
-    _metrics.counter("compiles").inc()
-    _metrics.timer("compile_s").observe(secs)
+    _record_compile_metrics(rec)
     (log if log is not None else _events.get_log()).emit(
-        "compile", step=step, **rec.asdict())
+        "compile", step=step, source="aot",
+        **(dict(cache_bypass="donation-unsafe-backend") if bypass
+           else {}),
+        **rec.asdict())
     return compiled, rec
 
+
+# ---------------------------------------------------------------------------
+# dispatch-path instrumentation
+# ---------------------------------------------------------------------------
+
+#: dispatch-path trace activity below this is not worth an event (tiny
+#: helper jits re-traced inline inside an enclosing trace)
+MIN_EVENT_TRACE_S = 0.005
+
+
+class InstrumentedJit:
+    """A thin proxy over a ``jax.jit`` object that attributes compiles
+    triggered by dispatch to ``label`` and reports them as ``compile``
+    events (``source="dispatch"``, signature fingerprint — no
+    re-lowering is ever forced on the dispatch path). Steady-state
+    calls pay one :class:`compile_watch` push/pop (~1 us); everything
+    else (``lower``, attribute access) passes through, so the lint
+    tier's ``.lower()`` audits and ``functools`` interop keep working.
+    """
+
+    __slots__ = ("_jitted", "_label", "_donated")
+
+    def __init__(self, jitted, label, donated=False):
+        self._jitted = jitted
+        self._label = label
+        self._donated = bool(donated)
+
+    def _bypass_cache(self):
+        """Donated program dispatched while the persistent cache is
+        wired on a donation-unsafe backend: any compile this call
+        triggers (first dispatch OR a later re-specialization — e.g. a
+        ``static_argnums`` stage index) must be fresh, so the whole
+        call runs under :class:`cache_bypass` (see
+        :func:`cache_donation_safe`). ~7 us per call, and only in
+        that specific configuration; undonated jits and safe backends
+        pay one bool."""
+        return should_bypass_cache(self._donated)
+
+    def __call__(self, *args, **kwargs):
+        with compile_watch(self._label) as w:
+            if self._bypass_cache():
+                with cache_bypass(watch=w):
+                    out = self._jitted(*args, **kwargs)
+            else:
+                out = self._jitted(*args, **kwargs)
+        if (w.compile_seconds > 0.0 or w.cache_hits or w.cache_misses
+                or w.trace_seconds >= MIN_EVENT_TRACE_S):
+            try:
+                rec = CompileRecord(
+                    label=self._label, trace_seconds=w.trace_seconds,
+                    compile_seconds=w.compile_seconds,
+                    fingerprint_kind="signature",
+                    cache_hits=w.cache_hits,
+                    cache_misses=w.cache_misses)
+                _record_compile_metrics(rec)
+                # fingerprint hashing only pays off when the event is
+                # actually recorded somewhere
+                if _events.get_log().enabled:
+                    rec.fingerprint, _ = signature_fingerprint(
+                        self._label, args, kwargs)
+                    _events.emit("compile", source="dispatch",
+                                 **rec.asdict())
+            except Exception:  # telemetry must never kill a dispatch
+                pass
+        return out
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+    def __repr__(self):
+        return f"InstrumentedJit({self._label!r}, {self._jitted!r})"
+
+
+def instrument_jit(jitted, label, donated=False):
+    """Wrap a ``jax.jit`` object so its compiles land in the compile
+    ledger under ``label``. The package's internal jit sites (steppers,
+    fused chunks, multigrid, spectra) all route through this — the
+    compile half of cold start stops being invisible. Pass
+    ``donated=True`` when the jit donates lattice buffers, so its first
+    compile bypasses the persistent cache on backends where a
+    cache-served donated executable corrupts
+    (:func:`cache_donation_safe`)."""
+    return InstrumentedJit(jitted, str(label), donated=donated)
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache
+# ---------------------------------------------------------------------------
+
+#: StableHLO markers of buffer donation (input->output aliasing) — the
+#: same attributes the lint tier's donation audit keys on
+_DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+
+_donation_safe_cache = None
+
+
+def cache_donation_safe():
+    """May a DONATED program be served from a deserialized
+    persistent-cache entry on this backend?
+
+    Measured on this container (jax/jaxlib 0.4.37, CPU backend): a
+    cache-served executable with donated inputs returns a CORRECT first
+    call and progressively corrupted results from the second call on —
+    the cold/warm smoke e2e caught the warmed run silently computing
+    garbage through all 12 steps (``bench_results/
+    cache_donation_repro.py`` is the standalone cross-process repro;
+    the corruption is racy but reproduces most runs). Undonated
+    programs, and donated programs compiled fresh, are unaffected — so
+    on CPU the answer is ``False`` and the drivers dispatch undonated
+    twins (a no-op there: XLA:CPU drops donation anyway, realized
+    ``alias_bytes`` is 0). TPU is untested on this container; the
+    consolidated TPU-window script carries
+    :func:`probe_cache_donation_safety` to settle it on hardware.
+    """
+    global _donation_safe_cache
+    if _donation_safe_cache is None:
+        try:
+            _donation_safe_cache = jax.default_backend() != "cpu"
+        except Exception:
+            return False
+    return _donation_safe_cache
+
+
+class cache_bypass:
+    """Context manager: compile fresh, neither reading nor writing the
+    persistent cache (``jax_enable_compilation_cache`` toggled off and
+    restored — the flag is not part of the trace context, so no
+    retraces are forced). The escape hatch donated compiles take on
+    backends where :func:`cache_donation_safe` is ``False``.
+
+    ``watch`` (an active :class:`compile_watch`) lets a dispatch-path
+    caller skip the latch reset on exits where nothing compiled inside
+    the block — steady-state calls of a donated program then pay only
+    the two config toggles, not a cache teardown per step."""
+
+    def __init__(self, watch=None):
+        self._watch = watch
+
+    def __enter__(self):
+        self._prev = bool(jax.config.jax_enable_compilation_cache)
+        jax.config.update("jax_enable_compilation_cache", False)
+        return self
+
+    def __exit__(self, *exc):
+        jax.config.update("jax_enable_compilation_cache", self._prev)
+        if self._prev and (self._watch is None or self._watch.compiled):
+            # jax latches cache-enablement at the first compile it
+            # inspects; if the bypassed compile was that first one, the
+            # latch froze the cache OFF for the task — clear it so
+            # later (undonated) compiles still cache
+            try:
+                from jax._src import compilation_cache as _cc
+                _cc.reset_cache()
+            except Exception:
+                pass
+
+
+def _cache_configured():
+    try:
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:
+        return False
+
+
+def should_bypass_cache(donated):
+    """The donated-compile cache-bypass policy, in ONE place for every
+    dispatch site (``compile_with_report``, ``InstrumentedJit``,
+    ``warmstart.WarmProgram``): a DONATED program must not have its
+    backend compile served from a persistent-cache entry on backends
+    where that corrupts repeat calls (:func:`cache_donation_safe`)."""
+    return (bool(donated) and _cache_configured()
+            and not cache_donation_safe())
+
+
+def probe_cache_donation_safety(trials=4, calls=3):
+    """Empirically probe the cached-donated-executable hazard on the
+    LIVE backend (requires :func:`ensure_compilation_cache` first):
+    compile a small donated RK-style step (populating the cache), then
+    per trial force the backend compile to re-run and be SERVED from
+    the persistent cache — ``jax.clear_caches()`` first, because a
+    fresh ``jax.jit`` wrapper alone is satisfied by jax's in-memory
+    executable caches and never touches the persistent one — and
+    compare ``calls`` repeated applications against an undonated
+    reference. Returns ``{"triggered", "trials", "mismatched_calls",
+    "cache_served_compiles", "populate_cache_served", "valid"}``;
+    ``valid`` is ``False`` when no compile was actually cache-served
+    (the hazard configuration never arose, so the verdict proves
+    nothing).
+
+    The measured CPU corruption only manifests in a process whose
+    donated compile is served from a cache populated by an EARLIER
+    process (``bench_results/cache_donation_repro.py``) — same-process
+    re-serving after ``clear_caches()`` stays clean there. So the
+    decisive probe is the one run in a fresh process against an
+    already-warm cache: ``populate_cache_served=True`` marks that
+    configuration (the TPU-window leg's warm phase), and a first-
+    process probe (``populate_cache_served=False``) only covers the
+    weaker same-process configuration. The corruption is race-like,
+    so a clean *valid* probe is evidence, not proof (hence multiple
+    trials). Side effect: ``clear_caches()`` drops every live jit
+    executable in the process — run the probe between workloads, not
+    inside one. CPU's verdict is already baked into
+    :func:`cache_donation_safe`."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    a_coefs = (0.0, -0.5, -1.2, -0.7, -0.3)
+    b_coefs = (0.1, 0.3, 0.8, 0.7, 0.2)
+
+    def step(state, dt):
+        y = state
+        k = jax.tree_util.tree_map(lambda x: x * 0, state)
+        for s in range(5):
+            lap = -6.0 * y["f"]
+            for ax in (1, 2, 3):
+                lap = lap + jnp.roll(y["f"], 1, ax) \
+                    + jnp.roll(y["f"], -1, ax)
+            r = {"f": y["dfdt"], "dfdt": lap - y["f"]}
+            k = jax.tree_util.tree_map(
+                lambda kk, rr, s=s: a_coefs[s] * kk + dt * rr, k, r)
+            y = jax.tree_util.tree_map(
+                lambda yy, kk, s=s: yy + b_coefs[s] * kk, y, k)
+        return y
+
+    rng = np.random.default_rng(17)
+    host = {n: rng.standard_normal((2, 16, 16, 16)).astype(np.float32)
+            for n in ("f", "dfdt")}
+    dt = np.float32(0.01)
+
+    def fresh():
+        return {k: jax.device_put(v) for k, v in host.items()}
+
+    ref = jax.block_until_ready(jax.jit(step)(fresh(), dt))
+    ref = {k: np.asarray(v) for k, v in ref.items()}
+    # populate the cache with the donated program's entry — in a FRESH
+    # process against an already-warm cache this compile is itself
+    # cache-served, which makes that process's probe the faithful
+    # cross-process repro (see below)
+    with compile_watch("donation_probe_populate") as wp:
+        jax.block_until_ready(
+            jax.jit(step, donate_argnums=0)(fresh(), dt))
+    mismatched = 0
+    served_compiles = 0
+    for _ in range(int(trials)):
+        # drop the in-memory executables so the next dispatch re-runs
+        # the backend compile — served (deserialized) from the
+        # persistent cache, the exact configuration the hazard needs
+        jax.clear_caches()
+        served = jax.jit(step, donate_argnums=0)
+        with compile_watch("donation_probe") as w:
+            out = jax.block_until_ready(served(fresh(), dt))
+        served_compiles += w.cache_hits
+        for call in range(int(calls)):
+            if call:
+                out = jax.block_until_ready(served(fresh(), dt))
+            if not all(np.array_equal(np.asarray(out[k]), ref[k])
+                       for k in ref):
+                mismatched += 1
+    return {"triggered": mismatched > 0, "trials": int(trials),
+            "mismatched_calls": mismatched,
+            "cache_served_compiles": int(served_compiles),
+            "populate_cache_served": wp.cache_hits > 0,
+            "valid": served_compiles > 0}
+
+
+def ensure_compilation_cache(cache_dir=None, log=None):
+    """Point jax's persistent compilation cache at ``cache_dir``
+    (default: the registered ``PYSTELLA_COMPILE_CACHE_DIR``). A process
+    that re-dials a device then pays each program's XLA backend compile
+    once per *cache lifetime*, not once per process — round 3 measured
+    ~365 s of multigrid compile at 512^3 that this line amortizes away.
+
+    The compile-time/entry-size floors are zeroed so even fast CPU
+    (smoke) compiles populate and hit the cache — the smoke cold/warm
+    e2e in CI depends on that, and production TPU compiles clear any
+    floor anyway. Values ``""``/``"0"``/``"off"``/``"none"`` disable.
+
+    Returns the absolute cache dir (``None`` when disabled). Emits one
+    ``compile_cache`` event recording the wiring.
+    """
+    if cache_dir is None:
+        from pystella_tpu import config as _config
+        cache_dir = _config.getenv("PYSTELLA_COMPILE_CACHE_DIR")
+    if (cache_dir is None
+            or str(cache_dir).strip().lower() in ("", "0", "off", "none")):
+        # an explicit "off" must also UN-WIRE a cache set earlier in
+        # the process (or inherited via JAX_COMPILATION_CACHE_DIR) —
+        # returning None while the cache keeps serving would let a
+        # driver report "disabled" over live cache traffic
+        try:
+            if jax.config.jax_compilation_cache_dir:
+                jax.config.update("jax_compilation_cache_dir", None)
+                from jax._src import compilation_cache as _cc
+                _cc.reset_cache()
+        except Exception:
+            pass
+        return None
+    cache_dir = str(cache_dir)
+    if not os.path.isabs(cache_dir):
+        # a relative configured path (the registered default is
+        # "bench_results/xla_cache") anchors at the repository root,
+        # not the invocation cwd — a warmed rerun from a different
+        # directory must find the same cache, and bench.py anchors
+        # its bench_results/ the same way
+        cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), cache_dir)
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # jax latches "is the cache enabled for this task" at the FIRST
+    # compile; any compile before this call (package import, another
+    # test) would freeze the cache off for the whole process — reset
+    # the latch so wiring takes effect now
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass
+    _install_jax_listeners()
+    (log if log is not None else _events.get_log()).emit(
+        "compile_cache", dir=cache_dir, enabled=True,
+        entries=len(os.listdir(cache_dir)),
+        donation_safe=cache_donation_safe())
+    return cache_dir
+
+
+# ---------------------------------------------------------------------------
+# device memory
+# ---------------------------------------------------------------------------
 
 def device_memory_stats(device=None):
     """Live allocator stats for ``device`` (default: first local device)

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from pystella_tpu import field as _field
 from pystella_tpu import step as _step
 from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import memory as _obs_memory
 from pystella_tpu.obs import metrics as _metrics
 from pystella_tpu.obs.scope import trace_scope
 from pystella_tpu.ops.derivs import _grad_coefs, _lap_coefs
@@ -167,8 +168,9 @@ class FusedScalarStepper(_step.Stepper):
         # eager-step peak-HBM footprint; the caller must not reuse the
         # state afterwards — see doc/performance.md "Memory").
         import jax
-        self._jit_step = jax.jit(
-            self._step_impl, donate_argnums=(0,) if donate else ())
+        self._jit_step = _obs_memory.instrument_jit(jax.jit(
+            self._step_impl, donate_argnums=(0,) if donate else ()),
+            label=f"fused.{type(self).__name__}.step", donated=donate)
         self._jit_multi = {}  # (nsteps, seq struct) -> jitted multi_step
         self._jit_coupled = {}  # (nsteps, grid_size, mpl, pair) -> jitted
         self._es_call = None  # lazily built energy-emitting stage kernel
@@ -311,7 +313,10 @@ class FusedScalarStepper(_step.Stepper):
             if not self._donate:
                 return call
             import jax
-            return jax.jit(call, donate_argnums=(0, 2))
+            return _obs_memory.instrument_jit(
+                jax.jit(call, donate_argnums=(0, 2)),
+                label=f"fused.{type(self).__name__}.stage_call",
+                donated=True)
 
         import jax
         from pystella_tpu.ops.pallas_stencil import (
@@ -362,9 +367,11 @@ class FusedScalarStepper(_step.Stepper):
         donate = (tuple(range(nw))
                   + tuple(range(nw + ns, nw + ns + len(extra_names)))
                   if self._donate else ())
-        sharded = jax.jit(decomp.shard_map(
-            body, in_specs, out_specs, check_vma=False),
-            donate_argnums=donate)
+        sharded = _obs_memory.instrument_jit(jax.jit(
+            decomp.shard_map(body, in_specs, out_specs, check_vma=False),
+            donate_argnums=donate),
+            label=f"fused.{type(self).__name__}.stage_call_sharded",
+            donated=bool(donate))
 
         def call(win_arrays, scalars, extras):
             flat = ([win_arrays[n] for n in windows]
@@ -682,7 +689,9 @@ class FusedScalarStepper(_step.Stepper):
                     with trace_scope("sentinel"):
                         hv = sentinel.compute(new)
                     return new, hv
-            fn = jax.jit(impl, donate_argnums=0)
+            fn = _obs_memory.instrument_jit(
+                jax.jit(impl, donate_argnums=0),
+                label=f"fused.multi_step[{int(nsteps)}]", donated=True)
             self._jit_multi[key] = fn
         return fn
 
@@ -1068,7 +1077,10 @@ class FusedScalarStepper(_step.Stepper):
                         hv = sentinel.compute(new, {"a": a2,
                                                     "adot": adot2})
                     return new, a2, adot2, hv
-            fn = jax.jit(impl, donate_argnums=0)
+            fn = _obs_memory.instrument_jit(
+                jax.jit(impl, donate_argnums=0),
+                label=f"fused.coupled_multi_step[{int(nsteps)}]",
+                donated=True)
             self._jit_coupled[key] = fn
         return fn
 

@@ -43,6 +43,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from pystella_tpu import _compat
 from pystella_tpu import config as _config
+from pystella_tpu.obs import memory as _obs_memory
 from pystella_tpu.obs.scope import trace_scope
 
 __all__ = ["StreamingStencil", "ResidentStencil", "OverlapStreamingStencil",
@@ -346,7 +347,11 @@ class ResidentStencil:
                 f"component at radius {self.h}) needs ~"
                 f"{need / 2**20:.0f} MB VMEM > the {budget / 2**20:.0f} MB "
                 "budget; use the streaming kernels or the halo path")
-        self._call = self._build()
+        # compile-ledger attribution: an eagerly-dispatched resident
+        # kernel's Mosaic/XLA build is a real cold-start cost
+        self._call = _obs_memory.instrument_jit(
+            self._build(),
+            label=f"pallas.resident{tuple(self.lattice_shape)}")
 
     def _build(self):
         nw, ns = len(self.win_defs), len(self.scalar_names)
@@ -517,7 +522,12 @@ class StreamingStencil:
                 f"multiple of the {LANE}-lane tile (got Z={Z}): Mosaic "
                 f"rejects windowed DMAs with unaligned lane slices; use "
                 f"the halo/roll path (or interpret mode) for this lattice")
-        self._calls = [self._build(j) for j in range(Y // self.by)]
+        self._calls = [
+            _obs_memory.instrument_jit(
+                self._build(j),
+                label=f"pallas.streaming{tuple(self.lattice_shape)}"
+                      f"[slab{j}]")
+            for j in range(Y // self.by)]
 
     # -- construction ------------------------------------------------------
 

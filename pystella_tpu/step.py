@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from pystella_tpu import field as _field
+from pystella_tpu.obs import memory as _obs_memory
 from pystella_tpu.obs.scope import trace_scope
 
 __all__ = [
@@ -129,8 +130,12 @@ class Stepper:
         # the step (the caller must not reuse the old state), letting XLA
         # alias them into the outputs — the difference between fitting
         # and not fitting large systems in HBM (doc/performance.md).
-        self._jit_step = jax.jit(
-            _step_impl, donate_argnums=(0,) if donate else ())
+        # Instrumented: a first-dispatch compile lands in the compile
+        # ledger (obs.memory) under a stable label instead of vanishing
+        # into startup time.
+        self._jit_step = _obs_memory.instrument_jit(
+            jax.jit(_step_impl, donate_argnums=(0,) if donate else ()),
+            label=f"step.{type(self).__name__}", donated=donate)
 
     def _ensure_stage_jits(self):
         """Per-stage executables for the reference-style driver loop
@@ -147,13 +152,16 @@ class Stepper:
         (VERDICT r4 #7; peak-HBM table in doc/performance.md)."""
         if not hasattr(self, "_jit_stage"):
             donate = getattr(self, "_donate", False)
-            self._jit_stage = jax.jit(
+            cls = type(self).__name__
+            self._jit_stage = _obs_memory.instrument_jit(jax.jit(
                 self.stage, static_argnums=0,
-                donate_argnums=(1,) if donate else ())
-            self._jit_stage0 = jax.jit(
+                donate_argnums=(1,) if donate else ()),
+                label=f"step.{cls}.stage", donated=donate)
+            self._jit_stage0 = _obs_memory.instrument_jit(jax.jit(
                 lambda state, t, dt, rhs_args:
                     self.stage(0, self.init_carry(state), t, dt, rhs_args),
-                donate_argnums=(0,) if donate else ())
+                donate_argnums=(0,) if donate else ()),
+                label=f"step.{cls}.stage0", donated=donate)
 
     # -- whole-step interface ---------------------------------------------
 
@@ -177,8 +185,11 @@ class Stepper:
                 with trace_scope("sentinel"):
                     hv = sentinel.compute(new, aux)
                 return new, hv
-            fn = jax.jit(impl, donate_argnums=(
-                (0,) if getattr(self, "_donate", False) else ()))
+            fn = _obs_memory.instrument_jit(
+                jax.jit(impl, donate_argnums=(
+                    (0,) if getattr(self, "_donate", False) else ())),
+                label=f"step.{type(self).__name__}.health",
+                donated=getattr(self, "_donate", False))
             cache[id(sentinel)] = fn
         return fn
 

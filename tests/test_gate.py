@@ -337,6 +337,151 @@ def test_gate_diverged_run_is_invalid_evidence():
                                 check_numerics=False)["exit_code"] == 0
 
 
+def _with_cold_start(rep, ttfs, claimed=False, artifacts=None):
+    rep = dict(rep)
+    rep["cold_start"] = {
+        "time_to_first_step_s": ttfs,
+        "phases": {"import_s": 1.0, "trace_s": 0.5,
+                   "compile_s": max(0.0, ttfs - 2.0),
+                   "first_dispatch_s": 0.1},
+        "compiles": [], "n_compile_events": 0,
+        "cache": {"dir": "/c", "hits": 4, "misses": 1,
+                  "hit_rate": 0.8},
+        "warmstart": {"claimed": claimed,
+                      "artifacts": artifacts or []},
+    }
+    return rep
+
+
+def test_gate_cold_start_regression():
+    """A time-to-first-step blowup fails CI like a slow step — but only
+    past BOTH the relative factor and the absolute floor (small-run
+    cold starts jitter by whole seconds)."""
+    base = _with_cold_start(_report(_steady()), 10.0)
+    bad = _with_cold_start(_report(_steady(seed=7)), 40.0)
+    verdict = gate.compare_reports(base, bad)
+    assert not verdict["ok"] and verdict["exit_code"] == 1
+    assert any("cold-start regression" in r for r in verdict["reasons"])
+    assert verdict["cold_start"]["baseline_s"] == 10.0
+    # within the factor: pass
+    ok = gate.compare_reports(
+        base, _with_cold_start(_report(_steady(seed=7)), 13.0))
+    assert ok["exit_code"] == 0
+    # past the factor but under the absolute floor: pass (2 s vs 5 s)
+    ok = gate.compare_reports(
+        _with_cold_start(_report(_steady()), 1.0),
+        _with_cold_start(_report(_steady(seed=7)), 3.0))
+    assert ok["exit_code"] == 0
+    # losing cold-start coverage warns, never fails
+    lost = gate.compare_reports(base, _report(_steady(seed=7)))
+    assert lost["exit_code"] == 0
+    assert any("cold-start coverage was lost" in w
+               for w in lost["warnings"])
+    # ... including a current cold_start section whose
+    # time-to-first-step is None (compile telemetry but the driver
+    # never reached a first step) — the metric is gone, not passing
+    none_cs = _with_cold_start(_report(_steady(seed=7)), 2.0)
+    none_cs["cold_start"]["time_to_first_step_s"] = None
+    lost2 = gate.compare_reports(base, none_cs)
+    assert lost2["exit_code"] == 0
+    assert any("coverage was lost" in w for w in lost2["warnings"])
+    # opt-out
+    assert gate.compare_reports(base, bad,
+                                check_cold_start=False)["exit_code"] == 0
+
+
+def test_gate_warmstart_fingerprint_mismatch_refused(tmp_path):
+    """The invalid-evidence refusal: a report CLAIMING warm start over
+    artifacts whose fingerprints mismatch measured something other than
+    the programs it says it ran — exit 2, never 0 or 1."""
+    base = _with_cold_start(_report(_steady()), 10.0)
+    cur = _with_cold_start(
+        _report(_steady(seed=7)), 3.0, claimed=True,
+        artifacts=[{"label": "step", "fingerprint": "abc123",
+                    "match": False,
+                    "reason": "versions: exported 0.4.0 vs live 0.4.37"}])
+    verdict = gate.compare_reports(base, cur)
+    assert verdict["exit_code"] == 2
+    assert any("claims warm start" in r and "mismatch" in r
+               for r in verdict["reasons"])
+    # matched artifacts pass clean
+    ok = gate.compare_reports(base, _with_cold_start(
+        _report(_steady(seed=7)), 3.0, claimed=True,
+        artifacts=[{"label": "step", "fingerprint": "abc123",
+                    "match": True}]))
+    assert ok["exit_code"] == 0
+    # an artifact that LOADED fine but computed different numbers than
+    # the jit path (the cached-donated-executable failure mode) is
+    # equally invalid evidence
+    ne = gate.compare_reports(base, _with_cold_start(
+        _report(_steady(seed=7)), 3.0, claimed=True,
+        artifacts=[{"label": "step", "fingerprint": "abc123",
+                    "match": True, "bitexact": False}]))
+    assert ne["exit_code"] == 2
+    assert any("different results" in r for r in ne["reasons"])
+    # the CLI pins the exit code (and --no-cold-start opts out)
+    bp = tmp_path / "b.json"
+    cp = tmp_path / "c.json"
+    bp.write_text(json.dumps(base))
+    cp.write_text(json.dumps(cur))
+    assert gate.main(["--baseline", str(bp), "--current", str(cp)]) == 2
+    assert gate.main(["--baseline", str(bp), "--current", str(cp),
+                      "--no-cold-start"]) == 0
+
+
+def test_ledger_cold_start_ingestion(tmp_path):
+    """cold_start/compile_cache/warmstart events land in the report's
+    cold_start section with the trace/compile split per program."""
+    path = str(tmp_path / "run.jsonl")
+    with events.EventLog(path) as log:
+        log.emit("bench_run", grid_shape=[8, 8, 8])
+        log.emit("compile_cache", dir="/c", enabled=True,
+                 donation_safe=False)
+        log.emit("compile", label="step", source="aot",
+                 trace_seconds=0.4, compile_seconds=1.6,
+                 fingerprint="abc", fingerprint_kind="lowered",
+                 cache_hits=0, cache_misses=1, cache_hit=False)
+        log.emit("compile", label="helper", source="dispatch",
+                 trace_seconds=0.1, compile_seconds=0.0,
+                 cache_hits=1, cache_misses=0, cache_hit=True)
+        log.emit("warmstart_load", label="step", fingerprint="abc",
+                 path="/w/step.jaxexport")
+        log.emit("warmstart_mismatch", label="old_step",
+                 fingerprint="stale1",
+                 reason="versions: exported 0.4.0 vs live 0.4.37")
+        log.emit("cold_start", time_to_first_step_s=4.5,
+                 phases={"import_s": 2.0, "trace_s": 0.4,
+                         "compile_s": 1.6, "first_dispatch_s": 0.1})
+        log.emit("step_time", ms=2.0)
+    led = ledger.PerfLedger.from_events(path)
+    cs = led.cold_start()
+    assert cs["time_to_first_step_s"] == 4.5
+    assert cs["phases"]["import_s"] == 2.0
+    assert cs["cache"]["dir"] == "/c"
+    assert cs["cache"]["hits"] == 1 and cs["cache"]["misses"] == 1
+    assert cs["cache"]["hit_rate"] == 0.5
+    # rows sorted slowest-first, trace/compile split carried through
+    assert cs["compiles"][0]["label"] == "step"
+    assert cs["compiles"][0]["trace_s"] == 0.4
+    assert cs["compiles"][0]["compile_s"] == 1.6
+    assert cs["compiles"][0]["cache_hit"] is False
+    assert cs["warmstart"]["claimed"] is True
+    assert cs["warmstart"]["artifacts"][0]["match"] is True
+    # a refused artifact is an HONEST fallback: it lands in
+    # `fallbacks` (the gate warns), never in `artifacts` as a
+    # match:False row (which the gate would refuse as invalid evidence)
+    assert len(cs["warmstart"]["artifacts"]) == 1
+    assert cs["warmstart"]["fallbacks"][0]["label"] == "old_step"
+    rep_full = led.report()
+    verdict = gate.compare_reports(rep_full, rep_full)
+    assert verdict["exit_code"] == 0
+    assert any("cold fallback" in w for w in verdict["warnings"])
+    md = ledger.render_markdown(led.report())
+    assert "Cold start" in md and "time to first step" in md
+    # a ledger with no compile telemetry has no cold_start section
+    assert ledger.PerfLedger(label="bare").cold_start() is None
+
+
 def test_gate_cli_exit_codes(tmp_path):
     """main() drives argparse -> comparison -> exit code, including the
     missing-baseline paths."""
@@ -370,14 +515,21 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     with invalid_evidence on a synthetic contamination burst. No
     performance assertion: CPU numbers only gate against themselves."""
     out = str(tmp_path / "bench_results")
+    cache_dir = str(tmp_path / "xla_cache")
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO
-    res = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
-         "--grid", "16", "--steps", "12", "--out", out],
-        capture_output=True, text=True, timeout=300, env=env)
+
+    def run_smoke(out_dir):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+             "--grid", "16", "--steps", "12", "--out", out_dir,
+             "--cache-dir", cache_dir],
+            capture_output=True, text=True, timeout=300, env=env)
+
+    # COLD leg: fresh compilation cache — every backend compile misses
+    res = run_smoke(out)
     assert res.returncode == 0, res.stderr[-2000:]
 
     report_path = os.path.join(out, "perf_report.json")
@@ -410,7 +562,55 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     kinds = {r["kind"] for r in events.read_events(
         os.path.join(out, "smoke_events.jsonl"))}
     assert {"bench_run", "compile", "step_time", "trace_summary",
-            "perf_report", "health"} <= kinds
+            "perf_report", "health", "cold_start", "compile_cache",
+            "warmstart_export"} <= kinds
+
+    # the cold leg's cold_start section: a full time-to-first-step
+    # breakdown, a per-program compile table with the trace/compile
+    # split, a cache MISS for the step program, and a verified
+    # (bit-exact, fingerprint-matched) AOT warm-start round trip
+    cold_cs = rep["cold_start"]
+    ph = cold_cs["phases"]
+    assert cold_cs["time_to_first_step_s"] > 0
+    assert all(ph[k] >= 0 for k in
+               ("import_s", "build_s", "trace_s", "compile_s",
+                "first_dispatch_s"))
+    step_rows = [c for c in cold_cs["compiles"]
+                 if c["label"] == "smoke_step"]
+    assert step_rows and step_rows[0]["cache_hit"] is False
+    assert step_rows[0]["trace_s"] > 0 and step_rows[0]["compile_s"] > 0
+    assert step_rows[0]["fingerprint_kind"] == "lowered"
+    assert cold_cs["cache"]["dir"] == cache_dir
+    ws = cold_cs["warmstart"]
+    assert ws["claimed"] is True
+    assert ws["artifacts"][0]["match"] is True
+    assert ws["artifacts"][0]["bitexact"] is True
+    assert "Cold start" in md
+
+    # WARM leg: same cache dir, fresh out dir — the PR acceptance
+    # criterion: cache hit rate >= 0.9 and a strictly lower
+    # time-to-first-step, with the warm-start round trip still
+    # bit-exact
+    out2 = str(tmp_path / "bench_results_warm")
+    res2 = run_smoke(out2)
+    assert res2.returncode == 0, res2.stderr[-2000:]
+    warm = json.load(open(os.path.join(out2, "perf_report.json")))
+    warm_cs = warm["cold_start"]
+    assert warm_cs["cache"]["hit_rate"] >= 0.9, warm_cs["cache"]
+    assert warm_cs["time_to_first_step_s"] \
+        < cold_cs["time_to_first_step_s"]
+    warm_step = [c for c in warm_cs["compiles"]
+                 if c["label"] == "smoke_step"][0]
+    assert warm_step["cache_hit"] is True
+    assert warm_cs["warmstart"]["artifacts"][0]["bitexact"] is True
+    # gating warm against cold passes (a faster cold start is an
+    # improvement, not a regression; the loose step threshold keeps
+    # CPU scheduler jitter out of THIS assertion — step-time gating
+    # has its own cases above)
+    warm_path = str(tmp_path / "warm_report.json")
+    json.dump(warm, open(warm_path, "w"))
+    assert gate.main(["--baseline", report_path, "--current", warm_path,
+                      "--threshold-pct", "300"]) == 0
 
     def run_gate(*args):
         return subprocess.run(
