@@ -773,6 +773,76 @@ def run_multigrid(n=512, ncycles=2):
     return (time.perf_counter() - start) / ncycles * 1e3
 
 
+def run_ensemble(n=16, size=None, nsteps=8, chunk=4, divergent=True,
+                 forensics_dir=None, label=None):
+    """Batched scenario population through the ensemble engine
+    (:mod:`pystella_tpu.ensemble`): ``size`` members of the ``n``^3
+    preheating system packed along the ensemble mesh axis, advanced
+    chunk-wise by the :class:`~pystella_tpu.EnsembleDriver` with the
+    per-member numerics sentinel piggybacked. With ``divergent=True``
+    ONE member's IC draw is seeded non-finite, so the run also proves
+    evict-and-resample end to end: the batch survives, a
+    ``member_evicted`` event (and, with ``forensics_dir``, a
+    member-scoped bundle) names the member and its parameter draw, and
+    the slot is resampled under a fresh seed. Emits
+    ``ensemble_run``/``ensemble_chunk``/``ensemble_done`` events into
+    whatever event log is configured — the ledger's ``ensemble``
+    report section and the gate's member-throughput verdict ingest
+    exactly these. Returns ``(member_steps_per_s, evictions)``."""
+    import jax
+    import pystella_tpu as ps
+    from pystella_tpu import obs
+
+    if size is None:
+        size = cfg().get_int("PYSTELLA_ENSEMBLE_SIZE")
+    grid_shape = (n, n, n)
+    # pack members over as many devices as divide the member count (the
+    # member axis must tile the ensemble device extent); the largest
+    # such divisor, not just a power of two — 6 members on 8 devices
+    # must pack 6, not 2
+    edev = max(d for d in range(1, min(size, len(jax.devices())) + 1)
+               if size % d == 0)
+    mesh = ps.ensemble_mesh(proc_shape=(1, 1, 1), ensemble_devices=edev,
+                            devices=jax.devices()[:edev])
+    decomp = ps.DomainDecomposition(mesh=mesh,
+                                    ensemble_axis=mesh.axis_names[0])
+    stepper, _, dt = build_preheat_step(grid_shape, fused=False,
+                                        decomp=decomp, make_state=False)
+    bad_seed = 1 if divergent else None
+
+    def sample(seed):
+        rng = np.random.default_rng(100 + seed)
+        state = {
+            "f": 1e-3 * rng.standard_normal(
+                (2,) + grid_shape).astype(np.float32),
+            "dfdt": 1e-4 * rng.standard_normal(
+                (2,) + grid_shape).astype(np.float32),
+        }
+        if seed == bad_seed:
+            # the forced-divergent draw: a non-finite IC the per-member
+            # sentinel must catch without killing the other members
+            state["f"][0, 0, 0, 0] = np.inf
+        return state, {"a": 1.0, "hubble": 0.5}
+
+    label = label or f"ensemble-{size}x{n}^3"
+    sink = (obs.ForensicSink(forensics_dir, label=label)
+            if forensics_dir else None)
+    scenario = ps.Scenario(f"preheat-{n}^3", stepper, sample,
+                           nsteps=nsteps, dt=dt)
+    driver = ps.EnsembleDriver(size=size, chunk=chunk, decomp=decomp,
+                               via="vmap", forensics=sink,
+                               emit_steps=True, label=label)
+    driver.submit(scenario, seeds=range(size))
+    out = driver.run()
+    st = out["stats"]
+    hb(f"{label}: {st['member_steps']} member-steps in "
+       f"{st['wall_s']:.2f}s -> {st['member_steps_per_s']:.1f} "
+       f"member-steps/s ({edev} ensemble device(s), "
+       f"{st['evictions']} eviction(s), occupancy "
+       f"{st['occupancy_mean']:.0%})")
+    return st["member_steps_per_s"], st["evictions"]
+
+
 # ---------------------------------------------------------------------------
 # smoke: tiny deterministic in-process run of the full evidence pipeline
 # ---------------------------------------------------------------------------
@@ -817,6 +887,10 @@ def run_smoke(argv=None):
     p.add_argument("--no-warmstart", action="store_true",
                    help="skip the AOT warm-start leg (export the smoke "
                         "step program, reload it, pin bit-exactness)")
+    p.add_argument("--no-ensemble", action="store_true",
+                   help="skip the batched-population payload (8 members "
+                        "x 16^3 through the ensemble driver with one "
+                        "forced-divergent member)")
     args = p.parse_args(argv)
 
     import contextlib
@@ -970,6 +1044,32 @@ def run_smoke(argv=None):
         obs.emit("halo_traffic",
                  bytes_per_step=overlap_seg[0].traced_halo_bytes(),
                  label="smoke-overlap")
+
+    # ensemble payload: a batched scenario population (8 members x 16^3
+    # packed along the ensemble mesh axis) through the EnsembleDriver
+    # with ONE forced-divergent member, so smoke -> ledger -> gate
+    # exercises member-steps/s, batch occupancy, and evict-and-resample
+    # end to end (the report's `ensemble` section and the gate's
+    # member-throughput verdict). The eviction is per-member physics,
+    # not a run failure: the batch completes and the report stays valid
+    # evidence (exactly one member_evicted event + one member-scoped
+    # forensic bundle).
+    if not args.no_ensemble:
+        try:
+            # chunk=2 keeps the unrolled batched-chunk graph (and its
+            # one-off XLA compile, the payload's dominant cost on a
+            # fresh cache) small — smoke is pipeline integrity, not a
+            # throughput claim
+            rate, nev = run_ensemble(
+                n=16, nsteps=4, chunk=2, divergent=True,
+                forensics_dir=os.path.join(args.out, "forensics"),
+                label="smoke-ensemble")
+            hb(f"smoke: ensemble {rate:.1f} member-steps/s, "
+               f"{nev} eviction(s)")
+        except Exception as e:  # noqa: BLE001 — record, never kill smoke
+            hb(f"smoke: ensemble payload failed: "
+               f"{type(e).__name__}: {e}")
+            traceback.print_exc()
 
     # AOT warm-start leg: export the very step program this run timed,
     # reload the artifact, and pin the loaded program bit-exact against
@@ -1242,11 +1342,20 @@ def payload(platform_wanted):
             "BENCH_MG_N", "64" if platform == "cpu" else "512")
         # multigrid's many-level V-cycle is compile-heavy: ~365 s of XLA
         # compile at 512^3 on v5e (measured), so it gets a doubled budget
+        ens_size = cfg().get_int("PYSTELLA_ENSEMBLE_SIZE")
         configs = [
             (f"wave-{wave_n}^3{suffix}",
              lambda: run_wave(wave_n), "site-updates/s", 1e9, budget),
             (f"gw-spectra-{spec_n}^3{suffix}",
              lambda: run_gw_spectra(spec_n), "ms/call", None, budget),
+            # batched-population throughput (ensemble engine): members
+            # packed along the ensemble mesh axis, clean draws — the
+            # ensemble_* events land in run_events.jsonl so hardware
+            # perf reports carry an `ensemble` section too
+            (f"ensemble-{ens_size}x16^3{suffix}",
+             lambda: run_ensemble(n=16, size=ens_size, nsteps=16,
+                                  divergent=False)[0],
+             "member-steps/s", None, budget),
             (f"multigrid-{mg_n}^3{suffix}",
              lambda: run_multigrid(mg_n), "ms/V-cycle", None,
              2 * budget)]

@@ -15,7 +15,15 @@ costs one leg, not the window):
 3. ``lint_tpu``     — PR 4+5: ``PYSTELLA_LINT_PLATFORM=tpu`` lint of
    the Mosaic lowering and realized donation; the sentinel-fusion
    check runs inside it (required scopes in ONE step module).
-4. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
+4. ``ensemble``     — PR 7: packed-small-lattice population
+   throughput. E members × 64³ packed one-per-chip along the ensemble
+   mesh axis (``bench.run_ensemble``, clean draws), recording
+   member-steps/s, member-steps/s/chip, and the derived
+   site-updates/s/chip so the packed figure is directly comparable
+   against the single-run 512³ headline — the mapping question the
+   ensemble engine exists to answer (when does packing a chip with
+   members beat sharding one lattice over chips).
+5. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
    wires a FRESH ``PYSTELLA_COMPILE_CACHE_DIR``, builds the 512³
    multigrid + preheat step programs cold (recording
    time-to-first-step and the trace/compile split), and AOT-exports
@@ -155,6 +163,38 @@ def worker_lint_tpu(dry_run):
     return rc
 
 
+def worker_ensemble(dry_run):
+    """Packed-small-lattice ensemble throughput: members along the
+    ensemble mesh axis (one member per chip at ``size == ndevices``),
+    advanced by the EnsembleDriver with clean draws. The derived
+    site-updates/s/chip (member-steps/s × n³ / chips) is the number to
+    hold against the single-run 512³ headline's
+    site-updates/sec/chip."""
+    backend, ndev, dial_s = _dial(dry_run)
+    sys.path.insert(0, REPO)
+    import bench
+    from pystella_tpu import obs
+
+    obs.configure(os.path.join(OUT, "tpu_window_events.jsonl"))
+    obs.ensure_compilation_cache(
+        os.path.join(OUT, "tpu_window_xla_cache"))
+    n = 16 if dry_run else 64
+    nsteps = 8 if dry_run else 64
+    size = max(ndev, 1)
+    t0 = time.perf_counter()
+    rate, nev = bench.run_ensemble(
+        n=n, size=size, nsteps=nsteps, chunk=4 if dry_run else 16,
+        divergent=False, label=f"window-ensemble-{size}x{n}^3")
+    record("ensemble", backend=backend, ndevices=ndev, grid=n,
+           size=size, nsteps=nsteps, dial_s=round(dial_s, 2),
+           wall_s=round(time.perf_counter() - t0, 2),
+           member_steps_per_s=rate,
+           member_steps_per_s_per_chip=rate / ndev,
+           site_updates_per_s_per_chip=rate * n**3 / ndev,
+           evictions=nev)
+    return 0 if rate and rate > 0 and nev == 0 else 1
+
+
 def worker_cold_start(dry_run, phase):
     """phase='cold': fresh cache, build + time everything, probe
     donation safety, export AOT artifacts. phase='warm': re-dial
@@ -257,7 +297,7 @@ def worker_cold_start(dry_run, phase):
 def main():
     p = argparse.ArgumentParser(prog="tpu_window_validation.py")
     p.add_argument("--legs", default="perf_trace,overlap,lint_tpu,"
-                                     "cold_start",
+                                     "ensemble,cold_start",
                    help="comma-separated legs, priority order")
     p.add_argument("--dry-run", action="store_true",
                    help="CPU + tiny grids: rehearse the plumbing")
@@ -270,7 +310,8 @@ def main():
     if args.worker:
         fn = {"perf_trace": worker_perf_trace,
               "overlap": worker_overlap,
-              "lint_tpu": worker_lint_tpu}.get(args.worker)
+              "lint_tpu": worker_lint_tpu,
+              "ensemble": worker_ensemble}.get(args.worker)
         if fn is not None:
             return fn(args.dry_run)
         if args.worker == "cold_start":

@@ -22,7 +22,8 @@ from pystella_tpu.field import (
     t, x, y, z,
 )
 from pystella_tpu.grid import Lattice
-from pystella_tpu.parallel import DomainDecomposition, make_mesh
+from pystella_tpu.parallel import (
+    DomainDecomposition, ensemble_mesh, make_mesh)
 from pystella_tpu.ops import (
     ElementWiseMap,
     FirstCenteredDifference, SecondCenteredDifference, FiniteDifferencer,
@@ -42,6 +43,9 @@ from pystella_tpu.models import (
     get_rho_and_p, Expansion,
 )
 from pystella_tpu import obs
+from pystella_tpu import ensemble
+from pystella_tpu.ensemble import (
+    EnsembleDriver, EnsembleMonitor, EnsembleStepper, Scenario)
 from pystella_tpu.utils import (Checkpointer, HealthMonitor,
     SimulationDiverged, OutputFile, ShardedSnapshot, StepTimer, timer,
     trace, advise_shapes)
@@ -87,7 +91,9 @@ __all__ = [
     "expand_stencil", "centered_diff",
     "exp", "log", "sin", "cos", "tan", "sinh", "cosh", "tanh", "sqrt",
     "fabs", "sign", "t", "x", "y", "z",
-    "Lattice", "DomainDecomposition", "make_mesh",
+    "Lattice", "DomainDecomposition", "ensemble_mesh", "make_mesh",
+    "ensemble", "EnsembleStepper", "EnsembleDriver", "Scenario",
+    "EnsembleMonitor",
     "ElementWiseMap",
     "FirstCenteredDifference", "SecondCenteredDifference",
     "FiniteDifferencer",

@@ -155,6 +155,24 @@ register("PYSTELLA_WARMSTART_DIR", default=None, kind="path",
               "artifacts there, skipping trace+compile for them — "
               "fingerprint mismatches fall back to the jit path and "
               "are recorded as warmstart_mismatch events")
+register("PYSTELLA_ENSEMBLE_SIZE", default="8", kind="int",
+         help="default member count for ensemble (batched-scenario) "
+              "runs: bench.py's smoke ensemble payload and "
+              "EnsembleDriver use it when no explicit size is given")
+register("PYSTELLA_ENSEMBLE_AXIS", default="ensemble",
+         help="name of the leading device-mesh axis the ensemble tier "
+              "packs members along (parallel.decomp.ensemble_mesh); "
+              "the lattice axes keep their x/y/z names after it")
+register("PYSTELLA_ENSEMBLE_MAX_EVICTIONS", default="16", kind="int",
+         help="evict-and-resample budget per ensemble run: beyond this "
+              "many member evictions the EnsembleMonitor declares the "
+              "whole batch diverged (SimulationDiverged) instead of "
+              "resampling forever — a configuration producing that "
+              "many bad draws is itself broken")
+register("PYSTELLA_ENSEMBLE_RESAMPLE", default="1", kind="bool",
+         help="eviction policy: 1 (default) resamples an evicted "
+              "member's slot from its scenario's sampler (fresh seed), "
+              "0 masks the slot out for the rest of the run instead")
 
 # ---------------------------------------------------------------------------
 # driver knobs (bench.py / bench_scaling.py / examples)

@@ -1,0 +1,40 @@
+"""Ensemble engine: batched scenario populations instead of single runs.
+
+Everything else in the package drives ONE lattice per process; the
+ROADMAP's production story (parameter scans, Monte-Carlo over IC seeds,
+coupling sweeps) needs a batch axis. This subsystem adds it end to end:
+
+- :mod:`pystella_tpu.ensemble.batch` —
+  :class:`EnsembleStepper`: ``vmap``/``lax.map`` wrappers over the
+  existing steppers that advance a population as ONE jitted program,
+  threading per-member parameters (couplings, dt, IC draws) as batched
+  pytree leaves with no re-trace per member. The device mesh side is
+  :func:`pystella_tpu.parallel.ensemble_mesh` — ``(ensemble, x, y, z)``
+  so small lattices pack the chip set along the member axis and large
+  ones keep their spatial sharding.
+- :mod:`pystella_tpu.ensemble.driver` — :class:`EnsembleDriver` +
+  :class:`Scenario`: a scenario-queue scheduler that groups
+  heterogeneous work into shape-compatible batches, advances each batch
+  chunk-wise with the numerics sentinel piggybacked, and refills slots
+  as members finish.
+- :mod:`pystella_tpu.ensemble.health` — :class:`EnsembleMonitor`:
+  per-member health matrices (the single-run sentinel reductions gain a
+  member axis) with **evict-and-resample** — a diverged member is
+  recorded (``member_evicted`` event + member-scoped forensic bundle)
+  and its slot resampled in-place, without killing or recompiling the
+  batch.
+
+Observability rides along: the :class:`~pystella_tpu.obs.ledger.
+PerfLedger` gains an ``ensemble`` report section (member-steps/s,
+evictions, occupancy), ``obs.gate`` a member-throughput verdict, and
+``pystella_tpu.lint`` lowers the vmapped batched step so the
+donation/collective/dtype audits cover the batched program too. See
+``doc/ensemble.md``.
+"""
+
+from pystella_tpu.ensemble.batch import EnsembleStepper
+from pystella_tpu.ensemble.driver import EnsembleDriver, Scenario
+from pystella_tpu.ensemble.health import EnsembleMonitor, Eviction
+
+__all__ = ["EnsembleStepper", "EnsembleDriver", "Scenario",
+           "EnsembleMonitor", "Eviction"]

@@ -163,6 +163,57 @@ def build_coupled_multi_step():
     return fn, args, kwargs, state
 
 
+def build_ensemble_step(size=4):
+    """The vmapped ensemble step+health program
+    (:meth:`pystella_tpu.ensemble.EnsembleStepper.health_jit`) on an
+    ``(ensemble, x, y, z)`` mesh packing ``size`` members along the
+    ensemble axis — the batched-population program the ensemble driver
+    dispatches. Auditing it proves the batching preserved the
+    single-run program's properties: state donation survives the vmap,
+    per-member stencils/reductions stay shard-local on the member axis
+    (no all-gather of the whole population), dtypes hold, and the
+    member-axis sentinel reductions fuse into the one batched step
+    module."""
+    import jax
+    import numpy as np
+    import pystella_tpu as ps
+    from pystella_tpu import obs
+
+    ndev = min(size, max(1, len(jax.devices())))
+    mesh = ps.ensemble_mesh(proc_shape=(1, 1, 1), ensemble_devices=ndev,
+                            devices=jax.devices()[:ndev])
+    decomp = ps.DomainDecomposition(mesh=mesh, ensemble_axis=
+                                    mesh.axis_names[0])
+    full_rhs, _, t, dt, rhs_args = _preheat_parts(decomp)
+    # donate=True: the driver loop rebinds batch = step(batch), so the
+    # input population buffers are dead — the audit pins that the
+    # aliasing survives the vmap (a donation miss here doubles the
+    # WHOLE population's HBM footprint, `size` times the single-run
+    # cost)
+    stepper = ps.LowStorageRK54(full_rhs, dt=dt, donate=True)
+    ens = stepper.batched(size, decomp=decomp, via="vmap", donate=True)
+
+    rng = np.random.default_rng(23)
+    members = []
+    for _ in range(size):
+        members.append({
+            "f": 1e-3 * rng.standard_normal(
+                (2,) + GRID).astype(np.float32),
+            "dfdt": 1e-4 * rng.standard_normal(
+                (2,) + GRID).astype(np.float32),
+        })
+    batch = ens.stack(members)
+    import jax.numpy as jnp
+    sentinel = obs.Sentinel.for_state(members[0], invariants={
+        "kinetic_mean": lambda st, aux: 0.5 * jnp.mean(
+            jnp.sum(jnp.square(st["dfdt"]), axis=0))})
+    fn = ens.health_jit(sentinel)
+    t_vec = ens.batch_args(np.float32(0.0))
+    dt_vec = ens.batch_args(dt)
+    bargs = ens.batch_args(rhs_args)
+    return fn, (batch, t_vec, dt_vec, bargs, {}), {}, batch
+
+
 def build_mg_smooth():
     """The multigrid V-cycle's hot kernel: a level-0 Jacobi smooth on a
     sharded mesh (the compiled body every cycle dispatches most)."""
@@ -236,6 +287,18 @@ def default_targets():
             dtype_policy=POLICY_F32,
             collectives=dict(REDUCTION_COLLECTIVES),
             fused_scopes=("fused_",),
+        ),
+        GraphTarget(
+            name="ensemble_step",
+            build=build_ensemble_step,
+            dtype_policy=POLICY_F32,
+            # per-member lattices are unsharded on the ensemble mesh
+            # (members pack the device axis), so the only collectives a
+            # correct batched program may carry are the tiny sentinel
+            # reductions — an all-gather here would mean the
+            # partitioner is replicating the population
+            collectives=dict(REDUCTION_COLLECTIVES),
+            fused_scopes=("ensemble_step", "rk_stage", "sentinel"),
         ),
         GraphTarget(
             name="mg_smooth",

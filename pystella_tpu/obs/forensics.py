@@ -90,11 +90,17 @@ def _field_history(history):
 def write_bundle(out_dir, step, reason, bad_fields=(),
                  offending_invariant=None, history=(), events_path=None,
                  events_window=200, checkpoint=None, config=None,
-                 label=""):
+                 label="", member=None, member_params=None):
     """Write one forensic bundle; returns the JSON path. Also emits a
     ``forensic_bundle`` run event pointing at it, so the event log's
     forensic tail (``diverged`` -> ``forensic_bundle`` ->
-    ``run_aborted``) links to the full record."""
+    ``run_aborted``) links to the full record.
+
+    For an ensemble trip (:mod:`pystella_tpu.ensemble`) the bundle is
+    PER MEMBER: ``member`` is the slot index of the diverged member and
+    ``member_params`` its parameter draw (couplings, dt, seed), so the
+    record names the bad scenario instead of dumping the whole batch —
+    ``history`` should then already be the member's own health series."""
     events_tail = []
     if events_path:
         events_tail = _events.read_events(events_path)[-int(events_window):]
@@ -109,6 +115,9 @@ def write_bundle(out_dir, step, reason, bad_fields=(),
             "reason": str(reason),
             "bad_fields": [str(f) for f in bad_fields],
             "offending_invariant": offending_invariant,
+            "member": None if member is None else int(member),
+            "member_params": _jsonify(member_params)
+            if member_params is not None else None,
         },
         "health_history": _jsonify(list(history)),
         "field_history": _jsonify(_field_history(history)),
@@ -119,13 +128,16 @@ def write_bundle(out_dir, step, reason, bad_fields=(),
         "last_good_checkpoint": _checkpoint_pointer(checkpoint),
     }
     os.makedirs(out_dir, exist_ok=True)
-    path = os.path.join(out_dir, f"forensic_bundle_step{int(step)}.json")
+    stem = (f"forensic_bundle_step{int(step)}" if member is None else
+            f"forensic_bundle_step{int(step)}_member{int(member)}")
+    path = os.path.join(out_dir, stem + ".json")
     with open(path, "w") as f:
         json.dump(bundle, f, indent=1, sort_keys=True)
         f.write("\n")
     _events.emit("forensic_bundle", step=step, path=path,
                  reason=str(reason), bad_fields=list(bad_fields),
-                 offending_invariant=offending_invariant, label=label)
+                 offending_invariant=offending_invariant, label=label,
+                 member=None if member is None else int(member))
     return path
 
 
@@ -169,7 +181,8 @@ class ForensicSink:
         self.last_bundle = None
 
     def write(self, step, reason, bad_fields=(),
-              offending_invariant=None, history=()):
+              offending_invariant=None, history=(), member=None,
+              member_params=None):
         try:
             self.last_bundle = write_bundle(
                 self.out_dir, step, reason, bad_fields=bad_fields,
@@ -177,7 +190,8 @@ class ForensicSink:
                 events_path=self.events_path,
                 events_window=self.events_window,
                 checkpoint=self.checkpoint, config=self.config,
-                label=self.label)
+                label=self.label, member=member,
+                member_params=member_params)
             return self.last_bundle
         except Exception as e:
             _events.emit("forensic_failed", step=step,

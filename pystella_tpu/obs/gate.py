@@ -14,7 +14,10 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       ``drift_factor`` x the baseline's (constraint drift worse than
       baseline fails CI the same way a slow step does) — or a
       COLD-START regression: time-to-first-step exceeds the baseline's
-      by both ``cold_start_factor`` and ``cold_start_floor`` seconds
+      by both ``cold_start_factor`` and ``cold_start_floor`` seconds —
+      or an ENSEMBLE regression: batched member throughput
+      (member-steps/s) drops more than ``ensemble_threshold_pct`` below
+      the baseline's
 2     invalid evidence: the contamination detector flagged the run
       (outlier burst / bimodal step times — the round-5 concurrent-probe
       signature), the report has no step samples, the run DIVERGED (a
@@ -188,7 +191,8 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     check_contamination="auto", check_numerics=True,
                     drift_factor=10.0, drift_floor=1e-12,
                     check_lint=True, check_cold_start=True,
-                    cold_start_factor=1.5, cold_start_floor=5.0):
+                    cold_start_factor=1.5, cold_start_floor=5.0,
+                    check_ensemble=True, ensemble_threshold_pct=20.0):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -225,6 +229,16 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     baseline slope cannot make any finite drift a regression) fails the
     gate exactly like a perf regression (exit 1) — a silent numerics
     regression fails CI the same way a slow step does.
+
+    ``check_ensemble`` (default on): when both reports carry an
+    ``ensemble`` section (:mod:`pystella_tpu.ensemble` batch totals), a
+    **member-throughput** drop of more than ``ensemble_threshold_pct``
+    vs the baseline's member-steps/s fails the gate (exit 1) — batched
+    population throughput is a first-class production metric, gated
+    like single-run step time. Lost ensemble coverage (baseline has the
+    section, current does not) degrades to a warning, and an eviction
+    count exceeding the baseline's warns too (evictions are legitimate
+    per-draw physics, but a jump usually means a broken sampler).
     """
     verdict = {"ok": True, "exit_code": 0, "reasons": [],
                "warnings": []}
@@ -409,7 +423,70 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
         _compare_cold_start(verdict, baseline, current,
                             factor=cold_start_factor,
                             floor_s=cold_start_floor)
+    if check_ensemble:
+        _compare_ensemble(verdict, baseline, current,
+                          threshold_pct=ensemble_threshold_pct)
     return verdict
+
+
+def _compare_ensemble(verdict, baseline, current, threshold_pct=20.0):
+    """Member-throughput comparison (mutates ``verdict`` in place): the
+    current ``ensemble.member_steps_per_s`` must stay within
+    ``threshold_pct`` of the baseline's. The threshold is wider than
+    the step-time gate's because a driver run's wall time includes
+    host-side queue management (occupancy changes jitter it); a real
+    batching regression (a lost vmap, a per-member re-trace) costs far
+    more than 20%. Coverage loss and eviction-count growth degrade to
+    warnings."""
+    ben = (baseline or {}).get("ensemble") or {}
+    cen = current.get("ensemble") or {}
+    if ben and not cen:
+        verdict["warnings"].append(
+            "ensemble: baseline carried an ensemble section but the "
+            "current run has none — member-throughput coverage was "
+            "lost")
+        return
+    # eviction growth is independent of the throughput metric: it must
+    # warn even when either run's rate is missing (a driver that died
+    # mid-run still counted its member_evicted events)
+    bev, cev = ben.get("evictions"), cen.get("evictions")
+    if isinstance(bev, int) and isinstance(cev, int) and cev > bev:
+        verdict["warnings"].append(
+            f"ensemble: {cev} member eviction(s) vs {bev} in the "
+            "baseline — more bad draws than the baseline configuration "
+            "produced")
+    b = ben.get("member_steps_per_s")
+    c = cen.get("member_steps_per_s")
+    if not isinstance(b, (int, float)) or b <= 0:
+        return
+    if not isinstance(c, (int, float)):
+        # the section exists (chunk/eviction events landed) but the
+        # throughput metric is gone — a driver that died mid-run never
+        # emits ensemble_done; a baseline-gated metric must not vanish
+        # silently
+        verdict["warnings"].append(
+            "ensemble: baseline tracked member_steps_per_s but the "
+            "current run's ensemble section carries none — "
+            "member-throughput coverage was lost")
+        return
+    drop_pct = 100.0 * (b - c) / b
+    verdict["ensemble"] = {
+        "baseline_member_steps_per_s": b,
+        "current_member_steps_per_s": c,
+        "drop_pct": drop_pct, "threshold_pct": threshold_pct,
+    }
+    if drop_pct > threshold_pct:
+        verdict.update(ok=False, exit_code=max(verdict["exit_code"], 1))
+        verdict["reasons"].append(
+            f"ensemble regression: member throughput {c:.4g} "
+            f"member-steps/s is {drop_pct:.1f}% below baseline "
+            f"{b:.4g} (threshold {threshold_pct:g}%) — check batch "
+            "occupancy and the chunk-dispatch distribution in the "
+            "report's ensemble section")
+    elif -drop_pct > threshold_pct:
+        verdict["warnings"].append(
+            f"ensemble improvement: member throughput {-drop_pct:.1f}% "
+            "above baseline — consider refreshing the baseline")
 
 
 def _compare_cold_start(verdict, baseline, current, factor=1.5,
@@ -557,6 +634,11 @@ def main(argv=None):
                    help="cold start: absolute seconds a regression must "
                         "also exceed (default 5; small-run cold starts "
                         "jitter by whole seconds)")
+    p.add_argument("--ensemble-threshold-pct", type=float, default=20.0,
+                   help="ensemble: allowed member-steps/s drop vs the "
+                        "baseline before the gate fails (default 20)")
+    p.add_argument("--no-ensemble", action="store_true",
+                   help="skip the ensemble member-throughput check")
     p.add_argument("--no-cold-start", action="store_true",
                    help="skip the cold-start checks (time-to-first-step "
                         "regression, warm-start fingerprint-mismatch "
@@ -605,7 +687,9 @@ def main(argv=None):
         check_lint=not args.no_lint,
         check_cold_start=not args.no_cold_start,
         cold_start_factor=args.cold_start_factor,
-        cold_start_floor=args.cold_start_floor)
+        cold_start_floor=args.cold_start_floor,
+        check_ensemble=not args.no_ensemble,
+        ensemble_threshold_pct=args.ensemble_threshold_pct)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

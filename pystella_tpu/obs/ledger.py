@@ -237,6 +237,9 @@ class PerfLedger:
         self.cache_info = {}            # compile_cache-event payload
         self.warmstart_loads = []       # warmstart_load payloads
         self.warmstart_mismatches = []  # warmstart_mismatch payloads
+        self.ensemble_runs = []         # ensemble_done payloads
+        self.ensemble_chunks_ms = []    # per-dispatch ms (ensemble_chunk)
+        self.ensemble_evictions = []    # member_evicted payloads
 
     # -- ingestion ---------------------------------------------------------
 
@@ -323,6 +326,20 @@ class PerfLedger:
                 led.warmstart_loads.append(data)
             elif kind == "warmstart_mismatch":
                 led.warmstart_mismatches.append(data)
+            elif kind == "ensemble_done":
+                # the ensemble driver's batch totals (member-steps/s,
+                # occupancy, evictions) -> the `ensemble` report section
+                led.ensemble_runs.append(data)
+            elif kind == "ensemble_chunk" and isinstance(
+                    data.get("ms"), (int, float)):
+                led.ensemble_chunks_ms.append(float(data["ms"]))
+            elif kind == "member_evicted":
+                led.ensemble_evictions.append(
+                    {"member": data.get("member"),
+                     "step": ev.get("step"),
+                     "scenario": data.get("scenario"),
+                     "fields": data.get("fields"),
+                     "params": data.get("params")})
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -571,6 +588,56 @@ class PerfLedger:
             "forensic_bundles": self.forensic_bundles,
         }
 
+    def ensemble(self):
+        """The ensemble-throughput summary (:mod:`pystella_tpu.
+        ensemble`): the driver's batch totals from ``ensemble_done``
+        events (member-steps/s, mean batch occupancy, members
+        completed), per-member throughput normalized per device
+        (``member_steps_per_s_per_device`` — the packed-small-lattice
+        figure of merit the TPU-window validation compares against the
+        single-run headline), a chunk-dispatch time distribution from
+        the ``ensemble_chunk`` events, and the eviction record (count +
+        the ``member_evicted`` events naming each member, its scenario,
+        and its parameter draw). ``None`` when the run carried no
+        ensemble telemetry at all. Several ``ensemble_done`` events
+        (one driver run per scenario group) are summed into the
+        totals."""
+        if not (self.ensemble_runs or self.ensemble_chunks_ms
+                or self.ensemble_evictions):
+            return None
+        member_steps = sum(int(r.get("member_steps") or 0)
+                           for r in self.ensemble_runs)
+        wall_s = sum(float(r.get("wall_s") or 0.0)
+                     for r in self.ensemble_runs)
+        completed = sum(int(r.get("members_completed") or 0)
+                        for r in self.ensemble_runs)
+        rate = member_steps / wall_s if wall_s > 0 else None
+        # the driver names each eviction in a member_evicted event AND
+        # counts them in the ensemble_done totals; trust whichever
+        # survived into the log (an event-window truncation must not
+        # understate the count)
+        evict_total = max(len(self.ensemble_evictions),
+                          sum(int(r.get("evictions") or 0)
+                              for r in self.ensemble_runs))
+        ndev = self.env.get("num_devices")
+        occs = [r.get("occupancy_mean") for r in self.ensemble_runs
+                if isinstance(r.get("occupancy_mean"), (int, float))]
+        return {
+            "runs": len(self.ensemble_runs),
+            "size": (self.ensemble_runs[-1].get("size")
+                     if self.ensemble_runs else None),
+            "member_steps": member_steps,
+            "wall_s": wall_s,
+            "member_steps_per_s": rate,
+            "member_steps_per_s_per_device":
+                (rate / ndev if rate and ndev else None),
+            "occupancy_mean": (sum(occs) / len(occs) if occs else None),
+            "members_completed": completed,
+            "evictions": evict_total,
+            "eviction_records": self.ensemble_evictions[:64],
+            "chunks": step_stats(self.ensemble_chunks_ms),
+        }
+
     # -- report ------------------------------------------------------------
 
     def report(self):
@@ -593,6 +660,7 @@ class PerfLedger:
             "overlap": self.overlap_summary(),
             "cold_start": self.cold_start(),
             "numerics": self.numerics(),
+            "ensemble": self.ensemble(),
             "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
@@ -807,6 +875,36 @@ def render_markdown(rep):
                    if d.get("offending_invariant") else ""))
         for b in nm.get("forensic_bundles") or []:
             lines.append(f"- forensic bundle: `{b}`")
+        lines.append("")
+    en = rep.get("ensemble")
+    if en:
+        lines += ["## Ensemble", ""]
+        lines.append(
+            f"- {_fmt(en.get('member_steps'), ',.0f')} member-steps in "
+            f"{_fmt(en.get('wall_s'))} s -> "
+            f"{_fmt(en.get('member_steps_per_s'))} member-steps/s"
+            + (f" ({_fmt(en['member_steps_per_s_per_device'])} per "
+               "device)" if en.get("member_steps_per_s_per_device")
+               else ""))
+        lines.append(
+            f"- batch size {_fmt(en.get('size'), '.0f')}, mean "
+            f"occupancy {_fmt(en.get('occupancy_mean'), '.1%')}, "
+            f"{_fmt(en.get('members_completed'), '.0f', '0')} member(s) "
+            f"completed over {_fmt(en.get('runs'), '.0f')} driver "
+            "run(s)")
+        ch = en.get("chunks") or {}
+        if ch.get("count"):
+            lines.append(
+                f"- {ch['count']} batched dispatch(es): p50 "
+                f"{_fmt(ch.get('p50_ms'))} ms, p90 "
+                f"{_fmt(ch.get('p90_ms'))} ms per chunk")
+        nev = en.get("evictions") or 0
+        lines.append(f"- {nev} member eviction(s)")
+        for e in (en.get("eviction_records") or [])[:8]:
+            lines.append(
+                f"  - member {e.get('member')} (scenario "
+                f"`{e.get('scenario')}`) at step {e.get('step')}: "
+                f"{e.get('fields')}")
         lines.append("")
     lines += [
         "## Per-scope breakdown",

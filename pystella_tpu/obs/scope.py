@@ -85,6 +85,9 @@ for _name in (
     "bench_step", "driver_step",
     # the in-graph numerics health vector (obs.sentinel)
     "sentinel",
+    # the ensemble tier (pystella_tpu.ensemble): the batched member
+    # step and the in-graph evict/resample slot write
+    "ensemble_step", "ensemble_evict",
 ):
     register_scope(_name)
 del _name

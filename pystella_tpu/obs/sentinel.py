@@ -201,6 +201,32 @@ class Sentinel:
             self._jit = jax.jit(self.compute)
         return self._jit(state, aux or {})
 
+    def compute_members(self, states, aux=None):
+        """The member-axis generalization of :meth:`compute` for the
+        ensemble tier (:mod:`pystella_tpu.ensemble`): ``states`` is a
+        batched state pytree whose leaves carry a leading member axis,
+        and the result is a ``(members, size)`` health MATRIX — row i
+        is exactly the vector :meth:`compute` would produce for member
+        i. Pure traceable jnp (a ``vmap`` of the single-run reductions,
+        so each member's pass stays shard-local on a member-sharded
+        mesh), callable inside any jitted ensemble step. ``aux`` leaves
+        must be batched to the member axis too (or the dict empty)."""
+        if aux:
+            return jax.vmap(self.compute)(states, aux)
+        return jax.vmap(lambda st: self.compute(st, {}))(states)
+
+    def decode_members(self, matrix):
+        """Host decode of a ``(members, size)`` health matrix — one
+        :meth:`decode` dict per row. The single device->host transfer
+        for a matured ensemble health check."""
+        m = np.asarray(matrix)
+        if m.ndim != 2 or m.shape[1] != self.size:
+            raise ValueError(
+                f"ensemble health matrix has shape {m.shape}; schema "
+                f"v{HEALTH_SCHEMA_VERSION} for this sentinel needs "
+                f"(members, {self.size})")
+        return [self.decode(row) for row in m]
+
     # -- host-side decode and checks ----------------------------------------
 
     def decode(self, vector):

@@ -695,6 +695,21 @@ class FusedScalarStepper(_step.Stepper):
             self._jit_multi[key] = fn
         return fn
 
+    def multi_step_fn(self, nsteps):
+        """The fused chunk body as a pure ``(state, t, dt, rhs_args) ->
+        state`` function (stage pairing across step boundaries, no
+        ``rhs_seq``) — the single-member entry point the ensemble tier
+        maps over a batch (:mod:`pystella_tpu.ensemble`). The Pallas
+        kernels keep each member's per-stage arithmetic inside opaque
+        ``pallas_call``\\ s, so a member mapped here is BIT-EXACT with
+        the same member run through :meth:`multi_step` alone."""
+        nsteps = int(nsteps)
+
+        def fn(state, t, dt, rhs_args):
+            return self._multi_step_impl(state, nsteps, t, dt,
+                                         rhs_args, {})
+        return fn
+
     def multi_step(self, state, nsteps, t=0.0, dt=None, rhs_args=None,
                    rhs_seq=None, sentinel=None):
         """Advance ``nsteps`` full RK steps as one jitted computation,

@@ -1,6 +1,6 @@
 from pystella_tpu.parallel.decomp import (
-    DomainDecomposition, HaloShells, make_mesh)
+    DomainDecomposition, HaloShells, ensemble_mesh, make_mesh)
 from pystella_tpu.parallel import multihost, overlap
 
-__all__ = ["DomainDecomposition", "HaloShells", "make_mesh",
-           "multihost", "overlap"]
+__all__ = ["DomainDecomposition", "HaloShells", "ensemble_mesh",
+           "make_mesh", "multihost", "overlap"]

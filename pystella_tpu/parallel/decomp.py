@@ -39,7 +39,8 @@ from pystella_tpu import _compat
 from pystella_tpu.obs.scope import trace_scope
 from pystella_tpu.parallel.overlap import MIN_INTERIOR_FACTOR
 
-__all__ = ["DomainDecomposition", "HaloShells", "make_mesh"]
+__all__ = ["DomainDecomposition", "HaloShells", "ensemble_mesh",
+           "make_mesh"]
 
 
 def make_mesh(proc_shape=None, axis_names=("x", "y", "z"), devices=None):
@@ -68,21 +69,95 @@ def make_mesh(proc_shape=None, axis_names=("x", "y", "z"), devices=None):
                                           explicit=len(devices) > 1))
 
 
+def ensemble_mesh(proc_shape=None, ensemble_devices=None,
+                  axis_names=("x", "y", "z"), ensemble_axis=None,
+                  devices=None):
+    """Build a ``(ensemble, x, y, z)`` device mesh — the ensemble
+    tier's mapping surface (:mod:`pystella_tpu.ensemble`): small
+    lattices keep ``proc_shape == (1, 1, 1)`` and pack the chip set
+    along the leading ensemble axis, large ones keep spatial sharding
+    with a smaller (possibly size-1) ensemble extent.
+
+    :arg proc_shape: devices per LATTICE axis within one ensemble
+        shard, e.g. ``(2, 2, 1)``; defaults to ``(1, 1, 1)`` (pure
+        member packing).
+    :arg ensemble_devices: devices along the ensemble axis; defaults to
+        ``len(devices) // prod(proc_shape)`` (use everything). This is
+        the DEVICE extent — the member count is independent: a batch of
+        E members over an ensemble extent of D places E/D members per
+        mesh slice.
+    :arg ensemble_axis: leading axis name (default: the registered
+        ``PYSTELLA_ENSEMBLE_AXIS``, normally ``"ensemble"``).
+
+    The returned mesh uses Auto axis types: batched member programs are
+    plain ``jit(vmap(...))`` over globally-sharded arrays, where the
+    partitioner propagates shardings itself — the declarative reshards
+    that want Explicit axes never run on the member axis.
+    """
+    from pystella_tpu import config as _config
+    devices = list(devices) if devices is not None else jax.devices()
+    if ensemble_axis is None:
+        ensemble_axis = _config.getenv("PYSTELLA_ENSEMBLE_AXIS")
+    if proc_shape is None:
+        proc_shape = (1,) * len(axis_names)
+    proc_shape = tuple(int(p) for p in proc_shape)
+    spatial = int(np.prod(proc_shape))
+    if ensemble_devices is None:
+        if len(devices) % spatial:
+            raise ValueError(
+                f"{len(devices)} devices do not tile proc_shape "
+                f"{proc_shape}; pass ensemble_devices or a device "
+                "subset explicitly")
+        ensemble_devices = len(devices) // spatial
+    ensemble_devices = int(ensemble_devices)
+    need = ensemble_devices * spatial
+    if need > len(devices):
+        raise ValueError(
+            f"ensemble mesh ({ensemble_devices},)+{proc_shape} needs "
+            f"{need} devices, have {len(devices)}")
+    mesh_devices = np.asarray(devices[:need]).reshape(
+        (ensemble_devices,) + proc_shape)
+    names = (ensemble_axis,) + tuple(axis_names[:len(proc_shape)])
+    return Mesh(mesh_devices,
+                names, **_compat.mesh_axis_types(len(names),
+                                                 explicit=False))
+
+
 class DomainDecomposition:
     """Shards 3-D lattice arrays over a device mesh and provides halo
     exchange plus collective verbs.
 
     :arg proc_shape: devices per axis (builds a mesh), or pass ``mesh=``.
     :arg halo_shape: default halo width ``h`` (per-op widths may override).
+    :arg ensemble_axis: name of a LEADING extra mesh axis carrying an
+        ensemble of members (a mesh from :func:`ensemble_mesh`). The
+        decomposition then describes each member's lattice — ``spec``/
+        ``sharding``/halo verbs see only the trailing lattice axes —
+        while :meth:`member_spec` / :meth:`member_sharding` /
+        :meth:`shard_members` place batched ``(members, ...)`` arrays
+        with the member axis over the ensemble devices.
     """
 
     def __init__(self, proc_shape=None, halo_shape=0, mesh=None,
-                 axis_names=("x", "y", "z"), devices=None):
+                 axis_names=("x", "y", "z"), devices=None,
+                 ensemble_axis=None):
         if mesh is None:
+            if ensemble_axis is not None:
+                raise ValueError("an ensemble decomposition needs an "
+                                 "explicit mesh (ensemble_mesh(...))")
             mesh = make_mesh(proc_shape, axis_names, devices)
         self.mesh = mesh
-        self.axis_names = tuple(mesh.axis_names)
-        self.proc_shape = tuple(mesh.devices.shape)
+        self.ensemble_axis = ensemble_axis
+        names = tuple(mesh.axis_names)
+        shape = tuple(mesh.devices.shape)
+        if ensemble_axis is not None:
+            if not names or names[0] != ensemble_axis:
+                raise ValueError(
+                    f"ensemble axis {ensemble_axis!r} must be the "
+                    f"mesh's leading axis; mesh has {names}")
+            names, shape = names[1:], shape[1:]
+        self.axis_names = names
+        self.proc_shape = shape
         if np.isscalar(halo_shape):
             halo_shape = (halo_shape,) * 3
         self.halo_shape = tuple(int(h) for h in halo_shape)
@@ -105,6 +180,54 @@ class DomainDecomposition:
 
     def sharding(self, outer_axes=0):
         return NamedSharding(self.mesh, self.spec(outer_axes))
+
+    # -- ensemble (member-axis) shardings ----------------------------------
+
+    @property
+    def ensemble_devices(self):
+        """Device extent of the ensemble mesh axis (1 without one)."""
+        if self.ensemble_axis is None:
+            return 1
+        return int(self.mesh.shape[self.ensemble_axis])
+
+    def member_spec(self, outer_axes=0):
+        """``PartitionSpec`` for a batched array ``(members,
+        *outer, *lattice)``: the leading member axis rides the ensemble
+        mesh axis, the trailing lattice axes keep their spatial
+        sharding — the ``(ensemble, x, y, z)`` layout that lets small
+        lattices pack the chip set and large ones keep sharding."""
+        if self.ensemble_axis is None or self.ensemble_devices == 1:
+            lead = (None,)
+        else:
+            lead = (self.ensemble_axis,)
+        names = [n if self.proc_shape[i] > 1 else None
+                 for i, n in enumerate(self.axis_names)]
+        return P(*(lead + (None,) * outer_axes + tuple(names)))
+
+    def member_sharding(self, outer_axes=0):
+        return NamedSharding(self.mesh, self.member_spec(outer_axes))
+
+    def shard_members(self, array, outer_axes=None):
+        """Place a batched ``(members, ...)`` array (host or device)
+        with the member axis over the ensemble devices and the lattice
+        axes over the spatial mesh. The ensemble device extent must
+        divide the member count. Leaves of rank below ``1 + lattice
+        rank`` (per-member scalars/vectors riding in the state pytree)
+        carry no lattice axes — only the member axis shards them."""
+        ndev = self.ensemble_devices
+        if ndev > 1 and array.shape[0] % ndev:
+            raise ValueError(
+                f"member count {array.shape[0]} not divisible by the "
+                f"ensemble device extent {ndev}; pad the batch or "
+                "choose a compatible mesh")
+        if outer_axes is None:
+            outer_axes = array.ndim - 1 - len(self.axis_names)
+        if outer_axes < 0:
+            lead = (None,) if (self.ensemble_axis is None or ndev == 1) \
+                else (self.ensemble_axis,)
+            spec = P(*(lead + (None,) * (array.ndim - 1)))
+            return jax.device_put(array, NamedSharding(self.mesh, spec))
+        return jax.device_put(array, self.member_sharding(outer_axes))
 
     @property
     def reduce_axes(self):
@@ -523,8 +646,8 @@ class DomainDecomposition:
             def body(x):
                 return self.pad_with_halos(x, halo)
 
-            fn = jax.jit(_compat.shard_map(
-                body, mesh=self.mesh, in_specs=spec, out_specs=spec))
+            fn = jax.jit(self.shard_map(body, in_specs=spec,
+                                        out_specs=spec))
             self._share_halos_cache[(halo, outer_axes)] = fn
         return fn(array)
 
@@ -532,7 +655,16 @@ class DomainDecomposition:
         """Thin wrapper over ``jax.shard_map`` bound to this mesh (via
         the version shim in :mod:`pystella_tpu._compat`).
         ``check_vma=False`` is needed for bodies containing ``pallas_call``
-        (whose outputs carry no varying-mesh-axes annotation)."""
+        (whose outputs carry no varying-mesh-axes annotation). On an
+        ensemble decomposition the replication check is off by default:
+        batched member bodies run under ``vmap(spmd_axis_name=<ensemble
+        axis>)``, where member-batched operands are device-varying over
+        the ensemble axis while unbatched captures (stencil
+        coefficients, scalars) are replicated — a mix the checker
+        rejects even though the program is correct (each member's
+        stencil reads only its own ensemble slice)."""
+        if self.ensemble_axis is not None:
+            kwargs.setdefault("check_vma", False)
         return _compat.shard_map(fn, mesh=self.mesh,
                                  in_specs=in_specs, out_specs=out_specs,
                                  **kwargs)
@@ -555,7 +687,9 @@ class DomainDecomposition:
         return tuple(n // p for n, p in zip(grid_shape, self.proc_shape))
 
     def __repr__(self):
-        return f"DomainDecomposition(proc_shape={self.proc_shape})"
+        ens = (f", ensemble={self.ensemble_devices}"
+               if self.ensemble_axis is not None else "")
+        return f"DomainDecomposition(proc_shape={self.proc_shape}{ens})"
 
 
 def _slice_region(tree, region):

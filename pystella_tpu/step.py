@@ -209,6 +209,32 @@ class Stepper:
         fn = self._health_jit(sentinel)
         return fn(state, t, dt, rhs_args or {}, aux or {})
 
+    # -- ensemble (member-axis) interface ----------------------------------
+
+    def multi_step_fn(self, nsteps):
+        """A pure ``(state, t, dt, rhs_args) -> state`` function
+        advancing ``nsteps`` full RK steps (time argument advanced by
+        ``dt`` per step) — the single-member body the ensemble tier
+        batches (:mod:`pystella_tpu.ensemble`): no jit, no donation,
+        no dispatch here, so it composes under ``vmap`` / ``lax.map``
+        / an outer jit. Fused steppers override this with their
+        stage-paired chunk body."""
+        nsteps = int(nsteps)
+
+        def fn(state, t, dt, rhs_args):
+            for i in range(nsteps):
+                state = self._step_impl(state, t + i * dt, dt, rhs_args)
+            return state
+        return fn
+
+    def batched(self, size, **kwargs):
+        """An :class:`~pystella_tpu.ensemble.EnsembleStepper` driving
+        ``size`` members of this stepper as one batched computation
+        (per-member t/dt/parameters as batched pytree leaves; see
+        :mod:`pystella_tpu.ensemble`)."""
+        from pystella_tpu.ensemble import EnsembleStepper
+        return EnsembleStepper(self, size, **kwargs)
+
     # -- per-stage interface (reference-style driver loops) ----------------
 
     def __call__(self, stage, state_or_carry, t=0.0, dt=None, **rhs_args):

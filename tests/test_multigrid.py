@@ -33,8 +33,15 @@ def zero_mean_arrays(rng, decomp, grid_shape, n):
 @pytest.mark.parametrize("h", [1])
 @pytest.mark.parametrize("Solver", [NewtonIterator, JacobiIterator])
 @pytest.mark.parametrize("MG", [FullApproximationScheme, MultiGridSolver])
-@pytest.mark.parametrize("proc_shape", [(1, 1, 1), (2, 2, 1), (2, 2, 2)],
-                         indirect=True)
+@pytest.mark.parametrize("proc_shape", [
+    (1, 1, 1), (2, 2, 1),
+    # `slow`: the (2,2,2) quartet costs ~87 s against the tier-1
+    # budget; every Solver x MG combo stays covered on the two meshes
+    # above, and the z-sharded (2,2,2) mesh itself stays covered by
+    # test_multigrid_cycles_and_replicated_levels and
+    # test_transfer_identities (unfiltered runs still execute these)
+    pytest.param((2, 2, 2), marks=pytest.mark.slow),
+], indirect=True)
 @pytest.mark.parametrize("grid_shape", [(32, 32, 32)], indirect=True)
 def test_multigrid(make_decomp, grid_shape, proc_shape, h, Solver, MG):
     decomp = make_decomp(proc_shape)
