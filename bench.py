@@ -61,6 +61,11 @@ EVIDENCE pipeline — per-step ``step_time`` events, a profiler capture
 parsed into per-scope durations, and a ``PerfLedger`` written to
 ``bench_results/perf_report.json`` + ``.md`` — so CI can smoke → gate
 (``python -m pystella_tpu.obs.gate``) end to end without hardware.
+It includes a supervised elastic-runtime drill (an injected mid-run
+device-loss fault survived via restore-from-last-good,
+``pystella_tpu.resilience``) whose incident lands in the report's
+``resilience`` section; the orchestrator's own TPU dial loop runs on
+the same ``resilience.retry`` policy library, loaded by file.
 """
 
 import json
@@ -99,16 +104,38 @@ def cfg():
     registered ``BENCH_*`` knob without importing the package."""
     global _CONFIG
     if _CONFIG is None:
-        import importlib.util
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "pystella_tpu", "config.py")
-        spec = importlib.util.spec_from_file_location("_bench_config", path)
-        _CONFIG = importlib.util.module_from_spec(spec)
-        # dataclasses resolves cls.__module__ through sys.modules at
-        # class-creation time, so the by-file module must be registered
-        sys.modules[spec.name] = _CONFIG
-        spec.loader.exec_module(_CONFIG)
+        _CONFIG = _load_by_file("_bench_config", "pystella_tpu",
+                                "config.py")
     return _CONFIG
+
+
+def _load_by_file(name, *relpath):
+    """Load a stdlib-only package module by file (no package import,
+    no jax) and register it in ``sys.modules`` (dataclasses resolves
+    ``cls.__module__`` through ``sys.modules`` at class-creation
+    time)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        *relpath)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_RETRY = None
+
+
+def retry_lib():
+    """``pystella_tpu/resilience/retry.py`` loaded by file — the
+    orchestrator's dial/retry policy is the tested library now, not a
+    hand-rolled loop (it is stdlib-only by contract, like config.py)."""
+    global _RETRY
+    if _RETRY is None:
+        _RETRY = _load_by_file("_bench_retry", "pystella_tpu",
+                               "resilience", "retry.py")
+    return _RETRY
 
 
 EVENTS_PATH = cfg().getenv("BENCH_EVENT_LOG") or os.path.join(
@@ -891,6 +918,12 @@ def run_smoke(argv=None):
                    help="skip the batched-population payload (8 members "
                         "x 16^3 through the ensemble driver with one "
                         "forced-divergent member)")
+    p.add_argument("--no-supervised", action="store_true",
+                   help="skip the supervised (elastic-runtime) payload: "
+                        "a 16^3 run under resilience.Supervisor with an "
+                        "injected mid-run device-loss fault, completed "
+                        "via restore-from-last-good — the report's "
+                        "`resilience` section derives from it")
     args = p.parse_args(argv)
 
     import contextlib
@@ -1068,6 +1101,67 @@ def run_smoke(argv=None):
                f"{nev} eviction(s)")
         except Exception as e:  # noqa: BLE001 — record, never kill smoke
             hb(f"smoke: ensemble payload failed: "
+               f"{type(e).__name__}: {e}")
+            traceback.print_exc()
+
+    # supervised (elastic-runtime) payload: a second tiny 16^3 run
+    # driven by resilience.Supervisor with a DEVICE-LOSS fault injected
+    # mid-run (simulated XlaRuntimeError UNAVAILABLE at step 9 of 12,
+    # checkpoints every 4 steps): the run completes by restoring the
+    # durable last-good checkpoint and replaying at most one interval,
+    # bit-consistent with an uninterrupted run of the same program.
+    # Exactly one incident (fault_detected -> recovery_attempt ->
+    # run_resumed with a measured MTTR) lands in the event log, the
+    # report's `resilience` section, and the gate's degraded-annotation
+    # path — the smoke e2e (tests/test_gate.py) pins all three.
+    if not args.no_supervised:
+        try:
+            import shutil
+            from pystella_tpu import resilience as rzl
+            sup_ck_dir = os.path.join(args.out, "supervised_ckpt")
+            shutil.rmtree(sup_ck_dir, ignore_errors=True)
+            sstepper, sstate, sdt = build_preheat_step(
+                (16, 16, 16), fused=False)
+            sargs = {"a": np.float32(1.0), "hubble": np.float32(0.5)}
+
+            def sup_step(st, i):
+                return sstepper.step(st, np.float32(0.0), sdt, sargs)
+
+            # clean reference trajectory for the bit-consistency pin
+            sref = {k: v for k, v in sstate.items()}
+            for i in range(12):
+                sref = sup_step(sref, i)
+            sync(sref)
+            smon_sup = ps.HealthMonitor(every=2,
+                                        metrics_prefix="supervised")
+            with ps.Checkpointer(sup_ck_dir, max_to_keep=2) as sup_ck:
+                sup = rzl.Supervisor(
+                    sup_step, sup_ck, 12, monitor=smon_sup,
+                    checkpoint_every=4,
+                    faults=rzl.FaultInjector.device_loss(
+                        step=9, label="smoke-supervised"),
+                    retry=rzl.RetryPolicy(base_s=0.05, max_s=0.2),
+                    label="smoke-supervised")
+                sup_rep = sup.run(sstate)
+            bit_ok = all(
+                np.array_equal(np.asarray(sup_rep["state"][k]),
+                               np.asarray(sref[k])) for k in sref)
+            inc = (sup_rep["incident_records"][0]
+                   if sup_rep["incident_records"] else {})
+            hb(f"smoke: supervised run "
+               f"{'completed' if sup_rep['completed'] else 'FAILED'} "
+               f"with {sup_rep['incidents']} incident(s) "
+               f"(MTTR {inc.get('mttr_s', float('nan')):.3f}s, "
+               f"{sup_rep['steps_replayed']} step(s) replayed, "
+               f"bit-consistent={bit_ok})")
+            if not (sup_rep["completed"] and bit_ok
+                    and sup_rep["incidents"] == 1):
+                obs.emit("smoke_supervised_failed",
+                         completed=sup_rep["completed"],
+                         incidents=sup_rep["incidents"],
+                         bitexact=bit_ok)
+        except Exception as e:  # noqa: BLE001 — record, never kill smoke
+            hb(f"smoke: supervised payload failed: "
                f"{type(e).__name__}: {e}")
             traceback.print_exc()
 
@@ -1515,9 +1609,20 @@ def main():
                  "BENCH_CONFIG_BUDGET": "90",
                  "BENCH_SUFFIX_EXTRA": ", insurance"})
 
+    # the dial/retry policy, promoted to pystella_tpu.resilience.retry
+    # (tested in tests/test_resilience.py) with exactly the behavior
+    # the hand-rolled loop had grown: deterministic failure => no
+    # retry; a tight crash loop (3 consecutive sub-120s failures:
+    # rc=4 plugin misconfig, rc=1 crash) => give up — only slow dial
+    # timeouts are worth retrying for as long as the budget lasts,
+    # with the original constant 10 s pause between attempts
+    rz = retry_lib()
+    retrier = rz.Retrier(
+        rz.RetryPolicy(base_s=10.0, factor=1.0, jitter=0.0,
+                       fast_failure_s=120.0, max_fast_failures=3),
+        emit=obs_event, label="tpu-dial")
     got_tpu = 0
     attempt = 0
-    fast_failures = 0
     while not force_cpu:
         remaining = total_budget - cpu_reserve - (time.time() - T0)
         if remaining < 120:
@@ -1537,27 +1642,18 @@ def main():
             hb(f"orchestrator: payload relayed {relayed} result(s) then "
                f"exited rc={rc}; keeping them")
             break
-        if rc == 3:
-            # device dialed fine but every config failed — deterministic;
-            # a redial would fail identically, so go straight to fallback
-            hb("orchestrator: device up but all configs failed (rc=3); "
-               "not retrying")
+        # rc=3: device dialed fine but every config failed — a redial
+        # would fail identically
+        decision, why = retrier.note_failure(
+            kind="deterministic" if rc == 3 else "transient",
+            duration_s=time.time() - t_attempt, error=f"rc={rc}")
+        if decision == "stop":
+            hb(f"orchestrator: giving up on TPU ({why})")
             break
-        # deterministic fast failures (rc=4 plugin misconfig, rc=1 crash)
-        # would otherwise burn the whole TPU budget in a tight retry loop;
-        # only slow dial timeouts are worth retrying indefinitely
-        if time.time() - t_attempt < 120:
-            fast_failures += 1
-            if fast_failures >= 3:
-                hb(f"orchestrator: {fast_failures} consecutive fast "
-                   f"failures (last rc={rc}); giving up on TPU")
-                break
-        else:
-            fast_failures = 0
         hb(f"orchestrator: attempt {attempt} produced no results "
            f"(rc={rc}); retrying" if rc is not None else
            f"orchestrator: attempt {attempt} timed out mid-dial; retrying")
-        time.sleep(10)
+        retrier.wait()
 
     if got_tpu == 0:
         # no fresh hardware number this run — close with the best cached

@@ -23,7 +23,14 @@ costs one leg, not the window):
    against the single-run 512³ headline — the mapping question the
    ensemble engine exists to answer (when does packing a chip with
    members beat sharding one lattice over chips).
-5. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
+5. ``elastic``      — PR 8: the elastic-runtime leg. A supervised run
+   (``resilience.Supervisor``) on the held device with an injected
+   mid-run device-loss fault: health-checked async checkpoints, a
+   re-dial, restore from the durable last-good checkpoint, bounded
+   replay, and a bit-consistency pin against an uninterrupted run —
+   recording the on-hardware MTTR and the checkpoint durability-
+   barrier overhead that CPU rehearsal cannot measure.
+6. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
    wires a FRESH ``PYSTELLA_COMPILE_CACHE_DIR``, builds the 512³
    multigrid + preheat step programs cold (recording
    time-to-first-step and the trace/compile split), and AOT-exports
@@ -195,6 +202,64 @@ def worker_ensemble(dry_run):
     return 0 if rate and rate > 0 and nev == 0 else 1
 
 
+def worker_elastic(dry_run):
+    """Supervised elastic run on the held device: inject a device-loss
+    fault mid-run, survive it end to end (durable last-good restore +
+    bounded replay), pin bit-consistency against an uninterrupted run,
+    and record the on-hardware MTTR + checkpoint-barrier overhead."""
+    backend, ndev, dial_s = _dial(dry_run)
+    import numpy as np
+    sys.path.insert(0, REPO)
+    import bench
+    import pystella_tpu as ps
+    from pystella_tpu import obs, resilience
+
+    obs.configure(os.path.join(OUT, "tpu_window_events.jsonl"))
+    obs.ensure_compilation_cache(
+        os.path.join(OUT, "tpu_window_xla_cache"))
+    n = 16 if dry_run else 128
+    nsteps = 12 if dry_run else 48
+    every = 4 if dry_run else 16
+    fault_step = nsteps - every + 1  # mid-interval, after >=1 durable ckpt
+
+    grid = (n, n, n)
+    stepper, state, dt = bench.build_preheat_step(grid, fused=False)
+    rhs_args = {"a": np.float32(1.0), "hubble": np.float32(0.5)}
+
+    def step_fn(st, i):
+        return stepper.step(st, np.float32(0.0), dt, rhs_args)
+
+    ref = state
+    for i in range(nsteps):
+        ref = step_fn(ref, i)
+    bench.sync(ref)
+
+    ck_dir = os.path.join(OUT, "tpu_window_elastic_ckpt")
+    import shutil
+    shutil.rmtree(ck_dir, ignore_errors=True)
+    mon = ps.HealthMonitor(every=4, metrics_prefix="supervised")
+    t0 = time.perf_counter()
+    with ps.Checkpointer(ck_dir, max_to_keep=2) as ck:
+        sup = resilience.Supervisor(
+            step_fn, ck, nsteps, monitor=mon, checkpoint_every=every,
+            faults=resilience.FaultInjector.device_loss(
+                step=fault_step, label="window-elastic"),
+            label="window-elastic")
+        rep = sup.run(state)
+    bit_ok = all(np.array_equal(np.asarray(rep["state"][k]),
+                                np.asarray(ref[k])) for k in ref)
+    inc = rep["incident_records"][0] if rep["incident_records"] else {}
+    record("elastic", backend=backend, ndevices=ndev, grid=n,
+           nsteps=nsteps, checkpoint_every=every,
+           dial_s=round(dial_s, 2),
+           wall_s=round(time.perf_counter() - t0, 2),
+           completed=rep["completed"], incidents=rep["incidents"],
+           mttr_s=inc.get("mttr_s"),
+           steps_replayed=rep["steps_replayed"], bit_consistent=bit_ok)
+    return 0 if (rep["completed"] and rep["incidents"] == 1
+                 and bit_ok) else 1
+
+
 def worker_cold_start(dry_run, phase):
     """phase='cold': fresh cache, build + time everything, probe
     donation safety, export AOT artifacts. phase='warm': re-dial
@@ -297,7 +362,7 @@ def worker_cold_start(dry_run, phase):
 def main():
     p = argparse.ArgumentParser(prog="tpu_window_validation.py")
     p.add_argument("--legs", default="perf_trace,overlap,lint_tpu,"
-                                     "ensemble,cold_start",
+                                     "ensemble,elastic,cold_start",
                    help="comma-separated legs, priority order")
     p.add_argument("--dry-run", action="store_true",
                    help="CPU + tiny grids: rehearse the plumbing")
@@ -311,7 +376,8 @@ def main():
         fn = {"perf_trace": worker_perf_trace,
               "overlap": worker_overlap,
               "lint_tpu": worker_lint_tpu,
-              "ensemble": worker_ensemble}.get(args.worker)
+              "ensemble": worker_ensemble,
+              "elastic": worker_elastic}.get(args.worker)
         if fn is not None:
             return fn(args.dry_run)
         if args.worker == "cold_start":

@@ -468,6 +468,11 @@ def main(argv=None):
                         > prev // p.checkpoint_interval)
             if save_due:
                 monitor.check_now(state, step=step_count)
+                # durability barrier for the PREVIOUS interval's save
+                # (it had a whole interval to land in the background),
+                # so last_good — the pointer a forensic bundle embeds —
+                # only ever names checkpoints confirmed on disk
+                ckpt.finalize()
                 # force=True: orbax's interval policy would drop saves at
                 # non-multiple steps (chunked crossings)
                 ckpt.save(step_count, state, metadata={

@@ -46,6 +46,9 @@ from pystella_tpu import obs
 from pystella_tpu import ensemble
 from pystella_tpu.ensemble import (
     EnsembleDriver, EnsembleMonitor, EnsembleStepper, Scenario)
+from pystella_tpu import resilience
+from pystella_tpu.resilience import (
+    FaultInjector, RecoveryFailed, RetryPolicy, Supervisor)
 from pystella_tpu.utils import (Checkpointer, HealthMonitor,
     SimulationDiverged, OutputFile, ShardedSnapshot, StepTimer, timer,
     trace, advise_shapes)
@@ -94,6 +97,8 @@ __all__ = [
     "Lattice", "DomainDecomposition", "ensemble_mesh", "make_mesh",
     "ensemble", "EnsembleStepper", "EnsembleDriver", "Scenario",
     "EnsembleMonitor",
+    "resilience", "Supervisor", "FaultInjector", "RetryPolicy",
+    "RecoveryFailed",
     "ElementWiseMap",
     "FirstCenteredDifference", "SecondCenteredDifference",
     "FiniteDifferencer",

@@ -173,6 +173,28 @@ register("PYSTELLA_ENSEMBLE_RESAMPLE", default="1", kind="bool",
          help="eviction policy: 1 (default) resamples an evicted "
               "member's slot from its scenario's sampler (fresh seed), "
               "0 masks the slot out for the rest of the run instead")
+register("PYSTELLA_RESILIENCE_CHECKPOINT_EVERY", default="50", kind="int",
+         help="default checkpoint interval in steps for the elastic "
+              "Supervisor (resilience.supervisor) — also the bound on "
+              "replayed steps after a fault: recovery restores the "
+              "durable last-good checkpoint and replays at most one "
+              "interval")
+register("PYSTELLA_RESILIENCE_MAX_RECOVERIES", default="4", kind="int",
+         help="incident budget per supervised run: beyond this many "
+              "recovered faults the Supervisor raises RecoveryFailed "
+              "instead of replaying forever — an environment producing "
+              "that many incidents needs an operator, not a retry loop")
+register("PYSTELLA_RESILIENCE_BACKOFF_BASE_S", default="1.0", kind="float",
+         help="first recovery-attempt backoff in seconds (jittered "
+              "exponential, factor 2) for the Supervisor's per-incident "
+              "retry loop (resilience.retry)")
+register("PYSTELLA_RESILIENCE_BACKOFF_MAX_S", default="60", kind="float",
+         help="recovery-attempt backoff ceiling in seconds")
+register("PYSTELLA_RESILIENCE_RETRY_BUDGET_S", default="600",
+         kind="float",
+         help="wall budget in seconds for ONE incident's recovery "
+              "attempts (re-dial + restore retries); exhausting it "
+              "raises RecoveryFailed with the last underlying error")
 
 # ---------------------------------------------------------------------------
 # driver knobs (bench.py / bench_scaling.py / examples)

@@ -15,7 +15,10 @@ key          meaning
 ``host``     jax process index (``0`` outside a jax process)
 ``kind``     event kind, a short snake_case string (``"compile"``,
              ``"diverged"``, ``"checkpoint_save"``, ``"mg_cycle"``,
-             ``"bench_metric"``, ...)
+             ``"bench_metric"``, ``"fault_detected"``, ...). Payload
+             keys must not shadow this schema's own field names —
+             e.g. the resilience events carry ``fault_kind``, not
+             ``kind`` (doc/observability.md lists the vocabulary)
 ``step``     simulation step number, or ``null``
 ``data``     kind-specific payload (flat, JSON-safe)
 ===========  ======================================================

@@ -16,7 +16,11 @@ does: one JSON file holding
   device kind, scheduler flags, ``PYSTELLA_*`` env);
 - a pointer to the last good checkpoint
   (:class:`~pystella_tpu.Checkpointer` directory + step), the state a
-  resume-and-bisect debug session starts from.
+  resume-and-bisect debug session — or an elastic
+  :class:`~pystella_tpu.resilience.Supervisor` recovery — starts
+  from. "Good" means **durable**: the pointer only ever names steps
+  past the checkpointer's durability barrier, never a write that was
+  merely scheduled when the run died (``doc/resilience.md``).
 
 :func:`write_bundle` / :func:`load_bundle` round-trip the schema;
 :class:`ForensicSink` is the configured writer a
@@ -62,7 +66,9 @@ def _jsonify(obj):
 def _checkpoint_pointer(checkpoint):
     """Resolve the last-good-checkpoint pointer: a
     :class:`~pystella_tpu.Checkpointer` (via its ``last_good``
-    property), an explicit ``{"directory", "step"}`` dict, or ``None``."""
+    property — durable steps only, so a trip racing an in-flight
+    write can never embed a torn checkpoint), an explicit
+    ``{"directory", "step"}`` dict, or ``None``."""
     if checkpoint is None:
         return None
     if isinstance(checkpoint, dict):

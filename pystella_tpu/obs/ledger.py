@@ -240,6 +240,17 @@ class PerfLedger:
         self.ensemble_runs = []         # ensemble_done payloads
         self.ensemble_chunks_ms = []    # per-dispatch ms (ensemble_chunk)
         self.ensemble_evictions = []    # member_evicted payloads
+        self.faults_injected = 0        # fault_injected events (harness)
+        self.faults_detected = []       # fault_detected payloads
+        self.recovery_attempts = 0      # recovery_attempt events
+        self.recovery_failures = []     # recovery_failed payloads
+        self.resumes = []               # run_resumed payloads
+        self.degraded_events = []       # run_degraded payloads
+        self.preempted_events = []      # run_preempted payloads
+        self.checkpoint_counts = {}     # checkpoint_* event kind -> count
+        self.durable_steps = []         # checkpoint_durable steps, in order
+        self.checkpoint_barrier_s = 0.0  # summed durability-barrier waits
+        self.supervisor_runs = []       # supervisor_done payloads, in order
 
     # -- ingestion ---------------------------------------------------------
 
@@ -340,6 +351,37 @@ class PerfLedger:
                      "scenario": data.get("scenario"),
                      "fields": data.get("fields"),
                      "params": data.get("params")})
+            elif kind == "fault_injected":
+                led.faults_injected += 1
+            elif kind == "fault_detected":
+                led.faults_detected.append(
+                    {"step": ev.get("step"),
+                     "kind": data.get("fault_kind"),
+                     "error": data.get("error"),
+                     "action": data.get("action")})
+            elif kind == "recovery_attempt":
+                led.recovery_attempts += 1
+            elif kind == "recovery_failed":
+                led.recovery_failures.append(data)
+            elif kind == "run_resumed":
+                led.resumes.append({"step": ev.get("step"), **data})
+            elif kind == "run_degraded":
+                led.degraded_events.append(
+                    {"step": ev.get("step"), **data})
+            elif kind == "run_preempted":
+                led.preempted_events.append(
+                    {"step": ev.get("step"), **data})
+            elif kind in ("checkpoint_save", "checkpoint_durable",
+                          "checkpoint_fallback", "checkpoint_restore"):
+                led.checkpoint_counts[kind] = \
+                    led.checkpoint_counts.get(kind, 0) + 1
+                if kind == "checkpoint_durable":
+                    if isinstance(ev.get("step"), (int, float)):
+                        led.durable_steps.append(int(ev["step"]))
+                    if isinstance(data.get("wait_s"), (int, float)):
+                        led.checkpoint_barrier_s += float(data["wait_s"])
+            elif kind == "supervisor_done":
+                led.supervisor_runs.append(data)
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -638,6 +680,115 @@ class PerfLedger:
             "chunks": step_stats(self.ensemble_chunks_ms),
         }
 
+    def resilience(self):
+        """The elastic-runtime summary (:mod:`pystella_tpu.resilience`):
+        the incident table (one row per recovered fault, from
+        ``run_resumed`` events with ``incident=True`` — kind, detect
+        step, MTTR, steps replayed, attempts), detected-vs-claimed
+        consistency against the supervisor's own ``supervisor_done``
+        totals, recovery-attempt and give-up counts, the checkpoint
+        record (saves scheduled vs durable, restore fallbacks, cadence
+        between durable steps, summed durability-barrier seconds and
+        their share of the supervised wall time), preemption/degrade
+        flags, and the fault-injection count (a drill's harness
+        activity is part of its evidence). ``None`` when the run
+        carried no resilience telemetry at all.
+
+        ``consistent`` is the gate's refusal trigger: a report whose
+        supervisors CLAIM fewer incidents than the event log's
+        RESOLVED (``run_resumed``) count is hiding a degraded fleet
+        behind a clean headline. Detected-but-unresolved incidents (a
+        run that died mid-recovery never wrote a ``supervisor_done``
+        and could not claim its fault) land in ``unresolved`` instead
+        — the gate warns on those, honestly."""
+        # checkpoint events alone do NOT make a resilience section: any
+        # plain Checkpointer-using driver emits them, and a section for
+        # every such run would make the gate's lost-resilience-coverage
+        # warning fire on runs that were never supervised — noise that
+        # trains operators to ignore the real warning. The section
+        # requires actual supervisor/fault telemetry; the checkpoint
+        # record then rides inside it.
+        if not (self.faults_detected or self.faults_injected
+                or self.resumes or self.recovery_failures
+                or self.preempted_events or self.supervisor_runs):
+            return None
+        incidents = [
+            {"kind": r.get("fault_kind"),
+             "detected_at_step": r.get("from_step"),
+             "restored_step": r.get("step"),
+             "mttr_s": r.get("mttr_s"),
+             "steps_replayed": r.get("steps_replayed"),
+             "attempts": r.get("attempts")}
+            for r in self.resumes if r.get("incident")]
+        detected = len([f for f in self.faults_detected
+                        if f.get("action") != "reraise"])
+        mttrs = [i["mttr_s"] for i in incidents
+                 if isinstance(i.get("mttr_s"), (int, float))]
+        replayed = sum(int(i.get("steps_replayed") or 0)
+                       for i in incidents)
+        # several supervised runs can share one ingestion window (a
+        # preempted run + its resumed successor, an ensemble beside a
+        # main run): the CLAIM the gate audits is their SUM — keeping
+        # only the last run's count would flag an honest multi-run log
+        # as inconsistent
+        claims = [r.get("incidents") for r in self.supervisor_runs
+                  if isinstance(r.get("incidents"), int)]
+        claimed = sum(claims) if claims else None
+        cadence = None
+        if len(self.durable_steps) >= 2:
+            deltas = [b - a for a, b in zip(self.durable_steps,
+                                            self.durable_steps[1:])
+                      if b > a]
+            if deltas:
+                cadence = percentile(sorted(deltas), 50)
+        walls = [r.get("wall_s") for r in self.supervisor_runs
+                 if isinstance(r.get("wall_s"), (int, float))]
+        wall_s = sum(walls) if walls else None
+        overhead_pct = None
+        if isinstance(wall_s, (int, float)) and wall_s > 0:
+            overhead_pct = 100.0 * self.checkpoint_barrier_s / wall_s
+        return {
+            "incidents": incidents,
+            "n_incidents": detected,
+            "resolved": len(incidents),
+            "unresolved": max(0, detected - len(incidents)),
+            "claimed_incidents": claimed,
+            # the claim is audited against RESOLVED incidents (each
+            # run_resumed row), not raw detections: a run that died
+            # mid-recovery never wrote a supervisor_done and could not
+            # claim its fault — that is the honest `unresolved` path
+            # (the gate warns), not a lie about recovered ones
+            "consistent": (claimed is None
+                           or int(claimed) >= len(incidents)),
+            # completed = every supervised run in the window either
+            # finished or handed off cleanly (a preemption drain is a
+            # clean hand-off, not a death mid-recovery)
+            "completed": (all(r.get("completed") or r.get("preempted")
+                              for r in self.supervisor_runs)
+                          if self.supervisor_runs else None),
+            "mttr_s_mean": (sum(mttrs) / len(mttrs) if mttrs else None),
+            "mttr_s_max": (max(mttrs) if mttrs else None),
+            "steps_replayed": replayed,
+            "recovery_attempts": self.recovery_attempts,
+            "recovery_failures": self.recovery_failures[:8],
+            "faults_injected": self.faults_injected,
+            "preempted": bool(self.preempted_events),
+            "degraded": self.degraded_events[:8],
+            "checkpoints": {
+                "saved": self.checkpoint_counts.get(
+                    "checkpoint_save", 0),
+                "durable": self.checkpoint_counts.get(
+                    "checkpoint_durable", 0),
+                "fallbacks": self.checkpoint_counts.get(
+                    "checkpoint_fallback", 0),
+                "restores": self.checkpoint_counts.get(
+                    "checkpoint_restore", 0),
+                "cadence_steps": cadence,
+                "barrier_s": self.checkpoint_barrier_s,
+                "barrier_pct_of_wall": overhead_pct,
+            },
+        }
+
     # -- report ------------------------------------------------------------
 
     def report(self):
@@ -661,6 +812,7 @@ class PerfLedger:
             "cold_start": self.cold_start(),
             "numerics": self.numerics(),
             "ensemble": self.ensemble(),
+            "resilience": self.resilience(),
             "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
@@ -905,6 +1057,59 @@ def render_markdown(rep):
                 f"  - member {e.get('member')} (scenario "
                 f"`{e.get('scenario')}`) at step {e.get('step')}: "
                 f"{e.get('fields')}")
+        lines.append("")
+    rz = rep.get("resilience")
+    if rz:
+        lines += ["## Resilience", ""]
+        n = rz.get("n_incidents") or 0
+        lines.append(
+            f"- {n} incident(s) detected, "
+            f"{_fmt(rz.get('resolved'), '.0f', '0')} recovered "
+            f"(MTTR mean {_fmt(rz.get('mttr_s_mean'))} s, max "
+            f"{_fmt(rz.get('mttr_s_max'))} s), "
+            f"{_fmt(rz.get('steps_replayed'), '.0f', '0')} step(s) "
+            f"replayed over "
+            f"{_fmt(rz.get('recovery_attempts'), '.0f', '0')} recovery "
+            "attempt(s)")
+        if rz.get("consistent") is False:
+            lines.append(
+                "- **INCONSISTENT**: the supervisor claims "
+                f"{rz.get('claimed_incidents')} incident(s) but the "
+                f"event log records {n} — the gate refuses this report")
+        incs = rz.get("incidents") or []
+        if incs:
+            lines += ["", "| kind | detected at | restored to | MTTR s "
+                          "| replayed | attempts |",
+                      "|---|---|---|---|---|---|"]
+            for i in incs[:12]:
+                lines.append(
+                    f"| {i.get('kind')} | {i.get('detected_at_step')} "
+                    f"| {i.get('restored_step')} "
+                    f"| {_fmt(i.get('mttr_s'))} "
+                    f"| {i.get('steps_replayed')} "
+                    f"| {i.get('attempts')} |")
+            lines.append("")
+        ck = rz.get("checkpoints") or {}
+        lines.append(
+            f"- checkpoints: {_fmt(ck.get('saved'), '.0f', '0')} "
+            f"scheduled, {_fmt(ck.get('durable'), '.0f', '0')} durable "
+            f"(cadence {_fmt(ck.get('cadence_steps'), '.0f')} steps), "
+            f"{_fmt(ck.get('fallbacks'), '.0f', '0')} walk-back "
+            f"fallback(s); durability barriers "
+            f"{_fmt(ck.get('barrier_s'))} s"
+            + (f" ({_fmt(ck.get('barrier_pct_of_wall'), '.2f')}% of "
+               "supervised wall time)"
+               if ck.get("barrier_pct_of_wall") is not None else ""))
+        if rz.get("faults_injected"):
+            lines.append(
+                f"- {rz['faults_injected']} fault(s) INJECTED by the "
+                "harness (a drill, not weather)")
+        if rz.get("preempted"):
+            lines.append("- run **preempted** (drained to a durable "
+                         "checkpoint; resume with the supervisor)")
+        for d in (rz.get("degraded") or [])[:4]:
+            lines.append(f"- **degraded** at step {d.get('step')}: "
+                         f"{d.get('note')}")
         lines.append("")
     lines += [
         "## Per-scope breakdown",

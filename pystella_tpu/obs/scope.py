@@ -88,6 +88,10 @@ for _name in (
     # the ensemble tier (pystella_tpu.ensemble): the batched member
     # step and the in-graph evict/resample slot write
     "ensemble_step", "ensemble_evict",
+    # the elastic runtime (pystella_tpu.resilience): each step taken
+    # under Supervisor control — replayed spans after a recovery show
+    # up as a second pass over the same step numbers in a trace
+    "supervised_step",
 ):
     register_scope(_name)
 del _name

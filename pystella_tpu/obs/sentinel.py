@@ -306,11 +306,19 @@ class SentinelMonitor:
         :class:`~pystella_tpu.obs.forensics.ForensicSink`; on a trip it
         receives the ring-buffer history before
         :class:`SimulationDiverged` is raised.
+    :arg metrics_prefix: prefix for this monitor's metric names. The
+        defaults — the ``sentinel`` timer and ``health_checks`` counter
+        — feed the ledger's ``numerics`` section (sentinel overhead %
+        of step time), so an AUXILIARY monitor running beside the main
+        one (e.g. the resilience supervisor's) must use its own names
+        (``"supervised"`` -> ``supervised_sentinel`` /
+        ``supervised_health_checks``) to keep that section honest,
+        exactly like the ensemble tier's ``ensemble_sentinel``.
     """
 
     def __init__(self, sentinel, every=50, history=64, max_abs=None,
                  invariant_bounds=None, emit_steps=False, label="",
-                 forensics=None):
+                 forensics=None, metrics_prefix=""):
         self.sentinel = sentinel
         self.every = int(every)
         self.max_abs = max_abs
@@ -318,6 +326,9 @@ class SentinelMonitor:
         self.emit_steps = bool(emit_steps)
         self.label = label
         self.forensics = forensics
+        prefix = f"{metrics_prefix}_" if metrics_prefix else ""
+        self._timer_name = prefix + "sentinel"
+        self._counter_name = prefix + "health_checks"
         self._pending = collections.deque()   # (step, device vector)
         self.history = collections.deque(maxlen=int(history))
         #: newest step pushed (None before the first push)
@@ -333,7 +344,7 @@ class SentinelMonitor:
     def observe(self, step, state, aux=None):
         """Compute the health vector of ``state`` (one tiny jitted
         dispatch, NO host sync) and enqueue it for ``step``."""
-        with _metrics.timer("sentinel"):
+        with _metrics.timer(self._timer_name):
             self.push(step, self.sentinel.compute_jit(state, aux))
 
     def push(self, step, vector):
@@ -366,12 +377,22 @@ class SentinelMonitor:
             n += 1
         return n
 
+    def discard(self):
+        """Drop every pending (unchecked) vector WITHOUT checking it —
+        the recovery path: after a fault rolls the run back, the queue
+        describes the corrupted trajectory about to be replayed, and
+        checking it would re-trip on history. Returns the number of
+        vectors dropped."""
+        n = len(self._pending)
+        self._pending.clear()
+        return n
+
     def check_sync(self, step, state, aux=None):
         """Synchronous one-off check of ``state`` at ``step`` (the
         legacy :class:`~pystella_tpu.HealthMonitor` contract; does not
         disturb the async queue). Raises on failure, returns the
         decoded vector otherwise."""
-        with _metrics.timer("sentinel"):
+        with _metrics.timer(self._timer_name):
             vector = self.sentinel.compute_jit(state, aux)
         return self._check_one(int(step), vector)
 
@@ -380,14 +401,14 @@ class SentinelMonitor:
         # the one host transfer — plus the checks); event-log JSONL
         # writes are I/O of the telemetry sink, not sentinel cost, and
         # stay outside it like every other event emission
-        with _metrics.timer("sentinel"):
+        with _metrics.timer(self._timer_name):
             decoded = self.sentinel.decode(vector)
             bad, why = self.sentinel.problems(
                 decoded, max_abs=self.max_abs,
                 invariant_bounds=self.invariant_bounds)
         self.checked_through = (step if self.checked_through is None
                                 else max(self.checked_through, step))
-        _metrics.counter("health_checks").inc()
+        _metrics.counter(self._counter_name).inc()
         self.history.append({"step": step, **decoded})
         if self.emit_steps:
             _events.emit("health", step=step, label=self.label, **decoded)

@@ -19,9 +19,9 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["init_multihost", "is_initialized", "global_devices",
-           "host_local_to_global", "global_to_host_local", "sync_hosts",
-           "all_gather_hosts"]
+__all__ = ["init_multihost", "is_initialized", "shutdown", "reinit",
+           "global_devices", "host_local_to_global",
+           "global_to_host_local", "sync_hosts", "all_gather_hosts"]
 
 _initialized = False
 
@@ -33,6 +33,11 @@ def init_multihost(coordinator_address=None, num_processes=None,
     With no arguments JAX auto-detects the cluster environment (TPU pod
     metadata, SLURM, ...). Single-process runs are a no-op, mirroring the
     reference's mpi4py-less fallback (decomp.py:119-127).
+
+    NOT a one-way latch: :func:`shutdown` tears the runtime down and
+    re-arms this function, so an elastic supervisor
+    (:mod:`pystella_tpu.resilience`) can re-dial after a device loss —
+    :func:`reinit` is the one-call spelling.
     """
     global _initialized
     if _initialized:
@@ -49,6 +54,42 @@ def init_multihost(coordinator_address=None, num_processes=None,
 
 def is_initialized():
     return _initialized or jax.process_count() > 1
+
+
+def _distributed_client():
+    """The live distributed-runtime client, or ``None`` (private jax
+    state, probed defensively so a jax refactor degrades to 'no
+    client', never a crash)."""
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None)
+    except Exception:
+        return None
+
+
+def shutdown():
+    """Tear down the multi-controller runtime (if any) and re-arm
+    :func:`init_multihost` — the ``_initialized`` latch is no longer
+    one-way, which is what a supervisor's re-dial after device loss
+    needs. Safe to call when nothing was initialized (single-process
+    runs: flag reset only). Errors from a runtime that is already dead
+    — the very situation a re-dial recovers from — are swallowed."""
+    global _initialized
+    if _distributed_client() is not None:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            # the coordinator/link may already be gone; the point of
+            # shutdown here is releasing local state so reinit can dial
+            pass
+    _initialized = False
+
+
+def reinit(**kwargs):
+    """:func:`shutdown` + :func:`init_multihost` — the supervisor's
+    re-dial. Single-process runs complete it as a cheap no-op."""
+    shutdown()
+    init_multihost(**kwargs)
 
 
 def global_devices():

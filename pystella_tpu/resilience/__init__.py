@@ -1,0 +1,42 @@
+"""Elastic runtime: retry/backoff, fault injection, and the supervisor.
+
+The production environment for this stack loses device links mid-run
+(rounds 3 and 5: 18 dial attempts over 9.5 h, all UNAVAILABLE). This
+package is the recovery layer that treats that as weather, not
+catastrophe:
+
+- :mod:`pystella_tpu.resilience.retry` — budget-aware jittered
+  exponential backoff with transient-vs-deterministic triage
+  (:func:`classify_exception`), promoted out of ``bench.py``'s
+  orchestrator, which now consumes it. Stdlib-only and loadable by
+  file, like ``config.py``.
+- :mod:`pystella_tpu.resilience.faults` — a deterministic
+  fault-injection harness (:class:`FaultInjector`: raise-at-step /
+  simulated device loss / NaN corruption / SIGTERM preemption) so
+  every recovery path is testable on the CPU mesh in tier-1.
+- :mod:`pystella_tpu.resilience.supervisor` — :class:`Supervisor`,
+  the driver wrapper: health-checked async durable checkpoints off the
+  step path, fault detection, re-dial/re-mesh, restore from the
+  durable last-good checkpoint, bounded replay, clean SIGTERM
+  preemption, and the incident telemetry
+  (``fault_detected``/``recovery_attempt``/``run_resumed``/
+  ``run_degraded``) the ledger's ``resilience`` report section and the
+  gate's degraded-annotation verdicts are built from.
+
+See ``doc/resilience.md`` for the supervisor contract, the fault
+taxonomy, and replay semantics.
+"""
+
+from pystella_tpu.resilience.retry import (
+    Retrier, RetryPolicy, classify_exception, retry_call)
+from pystella_tpu.resilience.faults import (
+    Fault, FaultInjector, NaNFault, RaiseFault, SigtermFault,
+    device_loss_error)
+from pystella_tpu.resilience.supervisor import RecoveryFailed, Supervisor
+
+__all__ = [
+    "Retrier", "RetryPolicy", "classify_exception", "retry_call",
+    "Fault", "FaultInjector", "NaNFault", "RaiseFault", "SigtermFault",
+    "device_loss_error",
+    "RecoveryFailed", "Supervisor",
+]

@@ -1,0 +1,244 @@
+"""Deterministic fault injection: make every recovery path testable.
+
+The production environment for this stack is demonstrably hostile
+(rounds 3 and 5 lost their entire TPU windows to transport outages),
+but hostile environments are a terrible test harness: they fail rarely,
+irreproducibly, and never in CI. This module is the controlled
+replacement — a :class:`FaultInjector` the
+:class:`~pystella_tpu.resilience.Supervisor` consults at every step
+boundary, firing scripted faults at exact step numbers so the recovery
+machinery (restore-from-last-good, bounded replay, preemption drain)
+runs end to end on the 8-device CPU mesh in tier-1.
+
+Fault taxonomy (``doc/resilience.md``):
+
+- :class:`RaiseFault` — raise an arbitrary exception entering step N.
+  With :func:`device_loss_error` it simulates the signature failure
+  mode: an ``XlaRuntimeError`` whose message carries ``UNAVAILABLE``
+  (the real class when jaxlib is present, a stand-in subclass named the
+  same otherwise — :func:`~pystella_tpu.resilience.retry.
+  classify_exception` keys on type name + message, so both classify
+  transient).
+- :class:`NaNFault` — corrupt one element of a named state field to
+  NaN entering step N: the silent-numerics failure the sentinel
+  (:mod:`pystella_tpu.obs.sentinel`) exists to catch. The corruption
+  round-trips through host and is re-placed with the leaf's own
+  sharding, so sharded states work unchanged.
+- :class:`SigtermFault` — send this process SIGTERM entering step N:
+  the preemption notice a managed TPU VM gets. The supervisor's
+  handler drains, checkpoints durably, and exits clean.
+
+Every fault is **one-shot by default** (``once=True``): after a
+recovery rolls the run back past the fault step, replaying through it
+must not re-fire — that is exactly the transient-fault contract. Pass
+``once=False`` to model a persistent (deterministic) fault and test
+the give-up path instead.
+
+Each firing emits a ``fault_injected`` run event, so a supervised run's
+event log records what the harness did to it alongside what the
+recovery machinery did about it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+import numpy as np
+
+from pystella_tpu.obs import events as _events
+
+__all__ = ["Fault", "RaiseFault", "NaNFault", "SigtermFault",
+           "FaultInjector", "device_loss_error"]
+
+
+def device_loss_error(detail="injected device loss (fault harness)"):
+    """An exception instance indistinguishable from a mid-run device
+    loss as far as classification goes: the real ``XlaRuntimeError``
+    when jaxlib exposes it, else a local ``RuntimeError`` subclass of
+    the same name; either way the message leads with ``UNAVAILABLE``,
+    so :func:`~pystella_tpu.resilience.retry.classify_exception` says
+    transient — the verdict a dying transport earns."""
+    msg = f"UNAVAILABLE: {detail}"
+    try:
+        from jax._src.lib import xla_client
+        return xla_client.XlaRuntimeError(msg)
+    except Exception:
+        cls = type("XlaRuntimeError", (RuntimeError,), {})
+        return cls(msg)
+
+
+class Fault:
+    """One scripted fault, armed for a step number.
+
+    :arg step: the (0-based) step index the fault fires *entering* —
+        i.e. before the step computation runs.
+    :arg once: disarm after the first firing (the transient contract);
+        ``False`` keeps it armed across replays (a persistent fault).
+    """
+
+    kind = "fault"
+
+    def __init__(self, step, once=True):
+        self.step = int(step)
+        self.once = bool(once)
+        self.fired = 0
+
+    def should_fire(self, step):
+        if self.once and self.fired:
+            return False
+        return int(step) == self.step
+
+    def fire(self, state):
+        """Apply the fault; returns the (possibly replaced) state or
+        raises. Subclasses implement :meth:`_fire`."""
+        self.fired += 1
+        return self._fire(state)
+
+    def _fire(self, state):
+        raise NotImplementedError
+
+    def describe(self):
+        return {"kind": self.kind, "step": self.step, "once": self.once}
+
+
+class RaiseFault(Fault):
+    """Raise ``error`` (an instance or zero-arg factory) at the step —
+    device loss, transport drop, or any exception under test."""
+
+    kind = "raise"
+
+    def __init__(self, step, error=None, once=True):
+        super().__init__(step, once=once)
+        self._error = error
+
+    def _fire(self, state):
+        err = self._error
+        if callable(err):
+            err = err()
+        if err is None:
+            err = device_loss_error()
+        raise err
+
+    def describe(self):
+        err = self._error if not callable(self._error) else None
+        return {**super().describe(),
+                "error": None if err is None else
+                f"{type(err).__name__}: {err}"}
+
+
+class NaNFault(Fault):
+    """Overwrite one element of state field ``field`` with NaN.
+
+    :arg field: dotted leaf name (top-level dict key covers the common
+        case).
+    :arg index: flat index into the raveled leaf (default 0).
+    """
+
+    kind = "nan"
+
+    def __init__(self, step, field, index=0, once=True):
+        super().__init__(step, once=once)
+        self.field = str(field)
+        self.index = int(index)
+
+    def _fire(self, state):
+        import jax
+        from pystella_tpu.obs.sentinel import named_leaves
+        leaves = named_leaves(state)
+        if self.field not in leaves:
+            raise KeyError(
+                f"NaNFault field {self.field!r} not in state leaves "
+                f"{sorted(leaves)}")
+        leaf = leaves[self.field]
+        host = np.array(leaf)  # host copy; the original stays intact
+        host.ravel()[self.index] = np.nan
+        sharding = getattr(leaf, "sharding", None)
+        corrupted = (jax.device_put(host, sharding)
+                     if sharding is not None else host)
+
+        def swap(path, x):
+            from pystella_tpu.obs.sentinel import _leaf_name
+            return corrupted if _leaf_name(path) == self.field else x
+
+        return jax.tree_util.tree_map_with_path(swap, state)
+
+    def describe(self):
+        return {**super().describe(), "field": self.field,
+                "index": self.index}
+
+
+class SigtermFault(Fault):
+    """Deliver SIGTERM to this very process at the step — the
+    preemption notice. The state passes through untouched; the
+    supervisor's installed handler turns the signal into a drain +
+    durable checkpoint + clean exit at the next step boundary."""
+
+    kind = "sigterm"
+
+    def _fire(self, state):
+        os.kill(os.getpid(), signal.SIGTERM)
+        return state
+
+
+class FaultInjector:
+    """A schedule of :class:`Fault`\\ s consulted once per step.
+
+    The supervisor calls :meth:`apply(step, state)` entering every
+    step; each armed fault whose step matches fires (emitting a
+    ``fault_injected`` event first, so the record survives even when
+    the fault raises). Convenience constructors cover the taxonomy::
+
+        FaultInjector.device_loss(step=9)
+        FaultInjector.nan(step=6, field="f")
+        FaultInjector.sigterm(step=5)
+
+    and compose: ``FaultInjector([RaiseFault(3), NaNFault(7, "f")])``.
+    """
+
+    def __init__(self, faults=(), label=""):
+        self.faults = list(faults)
+        self.label = label
+
+    # -- convenience constructors ------------------------------------------
+
+    @classmethod
+    def device_loss(cls, step, detail=None, once=True, label=""):
+        err = (device_loss_error if detail is None
+               else (lambda: device_loss_error(detail)))
+        return cls([RaiseFault(step, err, once=once)], label=label)
+
+    @classmethod
+    def nan(cls, step, field, index=0, once=True, label=""):
+        return cls([NaNFault(step, field, index=index, once=once)],
+                   label=label)
+
+    @classmethod
+    def sigterm(cls, step, label=""):
+        return cls([SigtermFault(step)], label=label)
+
+    @classmethod
+    def raise_at(cls, step, error, once=True, label=""):
+        return cls([RaiseFault(step, error, once=once)], label=label)
+
+    # -- the injection point -----------------------------------------------
+
+    def apply(self, step, state):
+        """Fire every armed fault scheduled for ``step``; returns the
+        (possibly corrupted) state, or raises what a raising fault
+        raised."""
+        for fault in self.faults:
+            if fault.should_fire(step):
+                desc = fault.describe()
+                # "kind"/"step" collide with emit()'s own parameters
+                desc["fault_kind"] = desc.pop("kind")
+                desc.pop("step", None)
+                _events.emit("fault_injected", step=step,
+                             label=self.label, **desc)
+                state = fault.fire(state)
+        return state
+
+    @property
+    def fired(self):
+        """Total firings so far across all scheduled faults."""
+        return sum(f.fired for f in self.faults)

@@ -12,11 +12,23 @@ restore places them back onto the same (or a compatible) mesh.
 The checkpoint state is any pytree: typically ``{"f": ..., "dfdt": ...}``
 plus host-side scalars (time, scale factor, step count) passed as
 ``metadata``.
+
+Durability is tracked explicitly (the elastic-runtime contract,
+``doc/resilience.md``): :meth:`Checkpointer.save` *schedules* an async
+write (``checkpoint_save`` event) and returns; only
+:meth:`Checkpointer.finalize` — the durability barrier, which a
+supervisor runs one interval later, off the step path — confirms the
+bytes are on disk, emits ``checkpoint_durable``, and lets
+:attr:`Checkpointer.last_good` advance. A crash mid-write can therefore
+never name a torn checkpoint as good, and :meth:`restore` walks back
+past a corrupt newest checkpoint (``checkpoint_fallback`` event) rather
+than failing the resume.
 """
 
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
@@ -50,33 +62,88 @@ class Checkpointer:
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps)
         self._mngr = ocp.CheckpointManager(self.directory, options=options)
+        #: steps whose async writes were scheduled but not yet
+        #: confirmed on disk (oldest first)
+        self._scheduled = []
+        # checkpoints already on disk survived their writer process, so
+        # their commit is complete: a resuming supervisor may trust
+        # them as durable immediately
+        self._durable = set(self._mngr.all_steps())
 
     # -- writing -----------------------------------------------------------
 
     def save(self, step, state, metadata=None, force=True):
-        """Write ``state`` (pytree of arrays) at ``step``. ``metadata`` is a
-        JSON-serializable dict (time, scale factor, rng keys as lists...).
-        An explicit ``save`` always writes (``force=True``), ignoring
-        ``save_interval_steps`` — use :meth:`maybe_save` for the throttled
-        in-loop call. Returns True if a save was performed."""
+        """SCHEDULE a write of ``state`` (pytree of arrays) at ``step``
+        — orbax writes asynchronously, so this returns as soon as the
+        device buffers are snapshot. ``metadata`` is a JSON-serializable
+        dict (time, scale factor, rng keys as lists...). An explicit
+        ``save`` always writes (``force=True``), ignoring
+        ``save_interval_steps`` — use :meth:`maybe_save` for the
+        throttled in-loop call. Returns True if a save was scheduled.
+
+        The ``checkpoint_save`` event this emits means *scheduled*, not
+        durable: call :meth:`finalize` (or :meth:`wait`) for the
+        durability barrier that emits ``checkpoint_durable`` and lets
+        :attr:`last_good` advance."""
         ocp = self._ocp
+        step = int(step)
+        if step in set(self._mngr.all_steps()):
+            # a replayed boundary re-saves a step that already exists
+            # on disk — e.g. the torn checkpoint a walk-back restore
+            # skipped, now being re-written clean, or a preemption
+            # drain landing exactly on a just-saved boundary. Replace
+            # it: orbax refuses in-place overwrites.
+            self._mngr.wait_until_finished()
+            try:
+                self._mngr.delete(step)
+            except Exception:
+                pass
+            self._durable.discard(step)
+            self._scheduled = [s for s in self._scheduled if s != step]
         args = {"state": ocp.args.StandardSave(state)}
         if metadata is not None:
             args["meta"] = ocp.args.JsonSave(_jsonify(metadata))
-        saved = self._mngr.save(int(step), args=ocp.args.Composite(**args),
+        saved = self._mngr.save(step, args=ocp.args.Composite(**args),
                                 force=force)
         if saved:
+            self._scheduled.append(int(step))
             _events.emit("checkpoint_save", step=step,
-                         directory=self.directory)
+                         directory=self.directory, durable=False)
         return bool(saved)
 
     def maybe_save(self, step, state, metadata=None):
         """Save only when ``step`` matches ``save_interval_steps``."""
         return self.save(step, state, metadata, force=False)
 
-    def wait(self):
-        """Block until async writes are durable."""
+    def finalize(self):
+        """The durability barrier: block until every scheduled write is
+        on disk, then mark those steps durable (one
+        ``checkpoint_durable`` event each) so :attr:`last_good` may
+        name them. Run by the supervisor one checkpoint interval after
+        each save — the write had a whole interval to land in the
+        background, so the barrier is (nearly) free and entirely off
+        the step path. Returns the newly-durable steps."""
+        if not self._scheduled:
+            return []
+        t0 = time.perf_counter()
         self._mngr.wait_until_finished()
+        wait_s = time.perf_counter() - t0
+        newly, self._scheduled = self._scheduled, []
+        # ONE barrier confirmed all of them: apportion its wall time
+        # across the events so a consumer summing wait_s (the ledger's
+        # barrier_s) recovers the true total, not len(newly) x it
+        share = wait_s / len(newly)
+        for s in newly:
+            self._durable.add(s)
+            _events.emit("checkpoint_durable", step=s,
+                         directory=self.directory,
+                         wait_s=round(share, 4))
+        return newly
+
+    def wait(self):
+        """Block until async writes are durable (alias of
+        :meth:`finalize`, kept for the original API)."""
+        self.finalize()
 
     # -- reading -----------------------------------------------------------
 
@@ -86,17 +153,21 @@ class Checkpointer:
 
     @property
     def last_good(self):
-        """Pointer to the newest checkpoint, as a JSON-safe
-        ``{"directory", "step"}`` dict (``None`` when nothing is saved
-        yet) — the resume-from-here record a forensic bundle embeds on
-        divergence (:mod:`pystella_tpu.obs.forensics`). "Good" holds by
-        construction: the drivers health-check the state (synchronously)
-        immediately before every save, so a diverged state is never
-        checkpointed."""
-        step = self.latest_step
-        if step is None:
+        """Pointer to the newest **durable** checkpoint, as a JSON-safe
+        ``{"directory", "step"}`` dict (``None`` when nothing durable
+        exists yet) — the resume-from-here record a forensic bundle
+        embeds on divergence (:mod:`pystella_tpu.obs.forensics`) and
+        the supervisor restores from after a fault. "Good" holds by
+        construction twice over: the drivers health-check the state
+        (synchronously) immediately before every save, so a diverged
+        state is never checkpointed — and only steps past the
+        :meth:`finalize` durability barrier qualify, so a crash
+        mid-write can never name a torn checkpoint as good."""
+        alive = set(self._mngr.all_steps())
+        good = [s for s in self._durable if s in alive]
+        if not good:
             return None
-        return {"directory": self.directory, "step": int(step)}
+        return {"directory": self.directory, "step": int(max(good))}
 
     def all_steps(self):
         return sorted(self._mngr.all_steps())
@@ -104,19 +175,41 @@ class Checkpointer:
     def restore(self, step=None, template=None, sharding_fn=None):
         """Restore ``(step, state, metadata)``.
 
-        :arg step: which checkpoint (default: newest).
+        :arg step: which checkpoint (default: newest). An EXPLICIT step
+            restores exactly that checkpoint or raises — the caller
+            asked for it by name.
         :arg template: optional pytree of abstract arrays
             (``jax.ShapeDtypeStruct`` with shardings) controlling placement;
             when given, arrays are restored directly onto its shardings.
         :arg sharding_fn: convenience alternative — a callable applied to
             each restored (host) array, e.g. ``decomp.shard``.
+
+        With ``step=None`` the restore **walks back**: a corrupt or
+        partial newest checkpoint (orbax raises mid-restore — the torn
+        artifact of a crash mid-write) falls back to the next-older
+        step with a ``checkpoint_fallback`` event instead of failing
+        the resume; only when every candidate fails does the last
+        error propagate.
         """
-        ocp = self._ocp
-        step = step if step is not None else self.latest_step
-        if step is None:
+        if step is not None:
+            return self._restore_one(int(step), template, sharding_fn)
+        candidates = sorted(self._mngr.all_steps(), reverse=True)
+        if not candidates:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
+        last_err = None
+        for cand in candidates:
+            try:
+                return self._restore_one(cand, template, sharding_fn)
+            except Exception as e:  # noqa: BLE001 — walk back, then re-raise
+                last_err = e
+                _events.emit("checkpoint_fallback", step=cand,
+                             directory=self.directory,
+                             error=f"{type(e).__name__}: {e}")
+        raise last_err
 
+    def _restore_one(self, step, template=None, sharding_fn=None):
+        ocp = self._ocp
         args = {}
         if template is not None:
             args["state"] = ocp.args.StandardRestore(template)

@@ -44,16 +44,23 @@ class HealthMonitor:
     :arg max_abs: optional magnitude bound — exceeding it also counts
         as divergence (useful to catch blowup before the first inf).
     :arg history: health vectors retained for the forensic bundle.
+    :arg metrics_prefix: metric-name prefix forwarded to the underlying
+        :class:`SentinelMonitor` — an auxiliary monitor (e.g. one owned
+        by a :class:`~pystella_tpu.resilience.Supervisor` running
+        beside a primary driver monitor) must set it so the ledger's
+        ``numerics`` section keeps describing the primary one only.
 
     Set :attr:`forensics` to a
     :class:`~pystella_tpu.obs.forensics.ForensicSink` to get a bundle
     written on every trip.
     """
 
-    def __init__(self, every=50, max_abs=None, history=64):
+    def __init__(self, every=50, max_abs=None, history=64,
+                 metrics_prefix=""):
         self.every = int(every)
         self.max_abs = max_abs
         self.history_size = int(history)
+        self.metrics_prefix = metrics_prefix
         #: optional ForensicSink consulted on a trip
         self.forensics = None
         self._mon = None
@@ -69,7 +76,8 @@ class HealthMonitor:
                 self._mon.flush()
             self._mon = _sentinel.SentinelMonitor(
                 _sentinel.Sentinel(names), every=self.every,
-                history=self.history_size, max_abs=self.max_abs)
+                history=self.history_size, max_abs=self.max_abs,
+                metrics_prefix=self.metrics_prefix)
             self._names = names
         self._mon.forensics = self.forensics
         return self._mon
@@ -91,6 +99,12 @@ class HealthMonitor:
     def flush(self):
         """Drain the pending queue unconditionally (loop exit)."""
         return 0 if self._mon is None else self._mon.flush()
+
+    def discard(self):
+        """Drop pending vectors WITHOUT checking them — the recovery
+        path: after a restore they describe the corrupted trajectory
+        being rolled back. Returns the number dropped."""
+        return 0 if self._mon is None else self._mon.discard()
 
     @property
     def checked_through(self):

@@ -1,0 +1,88 @@
+"""Subprocess worker for the SIGTERM-preemption round trip
+(tests/test_resilience.py): phase "preempt" runs a supervised toy loop
+whose fault harness SIGTERMs this very process mid-run — the supervisor
+must drain, take a durable checkpoint, and exit cleanly; phase
+"resume" restarts against the same checkpoint directory, resumes at
+the preemption step, completes, and pins the final state bit-identical
+to an uninterrupted run computed in-process.
+
+Each phase prints ONE JSON line on stdout; the test parses it.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import pystella_tpu as ps  # noqa: E402
+from pystella_tpu import resilience  # noqa: E402
+
+NSTEPS = 12
+EVERY = 4
+
+_step_jit = jax.jit(
+    lambda s: {"f": s["f"] * np.float32(0.9)
+               + np.float32(0.01) * jnp.roll(s["f"], 1)})
+
+
+def step_fn(state, step):
+    return _step_jit(state)
+
+
+def initial_state():
+    rng = np.random.default_rng(11)
+    return {"f": jnp.asarray(
+        rng.standard_normal((4, 8)).astype(np.float32))}
+
+
+def main():
+    phase = sys.argv[1]
+    ck_dir = sys.argv[2]
+    with ps.Checkpointer(ck_dir, max_to_keep=3) as ck:
+        if phase == "preempt":
+            sup = resilience.Supervisor(
+                step_fn, ck, NSTEPS, checkpoint_every=EVERY,
+                faults=resilience.FaultInjector.sigterm(step=6),
+                label="worker-preempt")
+            rep = sup.run(initial_state(), resume=False)
+            print(json.dumps({
+                "preempted": rep["preempted"],
+                "completed": rep["completed"],
+                "checkpoint_step": rep["final_step"],
+                "last_good": rep["last_good"],
+            }), flush=True)
+            return 0 if (rep["preempted"] and not rep["completed"]
+                         and rep["last_good"] is not None) else 1
+        if phase == "resume":
+            sup = resilience.Supervisor(
+                step_fn, ck, NSTEPS, checkpoint_every=EVERY,
+                label="worker-resume")
+            rep = sup.run(resume=True)
+            ref = initial_state()
+            for i in range(NSTEPS):
+                ref = step_fn(ref, i)
+            bit = np.array_equal(np.asarray(rep["state"]["f"]),
+                                 np.asarray(ref["f"]))
+            resumed_from = rep["final_step"] - rep["steps_run"]
+            print(json.dumps({
+                "completed": rep["completed"],
+                "final_step": rep["final_step"],
+                "resumed_from": resumed_from,
+                "bit_consistent": bool(bit),
+            }), flush=True)
+            return 0 if (rep["completed"] and bit) else 1
+    print(json.dumps({"error": f"unknown phase {phase!r}"}), flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

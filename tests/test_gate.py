@@ -575,6 +575,28 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     assert evr["member"] is not None and evr["params"]["seed"] == 1
     assert en["chunks"]["count"] > 0
     assert "## Ensemble" in md
+    # the supervised (elastic-runtime) payload ran end to end: an
+    # injected mid-run device-loss fault was survived via restore from
+    # the durable last-good checkpoint — EXACTLY ONE incident with a
+    # measured MTTR and a replay bounded by the checkpoint interval,
+    # the supervisor's claim consistent with the event record, and the
+    # durability split visible (saves scheduled AND confirmed durable)
+    rz = rep["resilience"]
+    assert rz["n_incidents"] == 1 and rz["resolved"] == 1, rz
+    assert rz["consistent"] is True and rz["completed"] is True
+    rz_inc = rz["incidents"][0]
+    assert rz_inc["kind"] == "device_loss"
+    assert rz_inc["mttr_s"] > 0
+    assert rz_inc["steps_replayed"] <= 4
+    assert rz["checkpoints"]["durable"] >= 2
+    assert rz["checkpoints"]["fallbacks"] == 0
+    assert rz["faults_injected"] == 1
+    assert "## Resilience" in md
+    rz_kinds = {r["kind"] for r in events.read_events(
+        os.path.join(out, "smoke_events.jsonl"))}
+    assert {"fault_injected", "fault_detected", "recovery_attempt",
+            "run_resumed", "checkpoint_durable",
+            "supervisor_done"} <= rz_kinds
     ens_kinds = {r["kind"] for r in events.read_events(
         os.path.join(out, "smoke_events.jsonl"))}
     assert {"ensemble_run", "ensemble_chunk", "ensemble_done",
@@ -613,12 +635,13 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     # criterion: cache hit rate >= 0.9 and a strictly lower
     # time-to-first-step, with the warm-start round trip still
     # bit-exact
-    # (--no-ensemble: the ensemble payload proved itself on the cold
-    # leg above; rerunning it would spend tier-1 budget re-verifying
-    # the same pipeline. Gating warm-vs-cold below therefore also
-    # covers the lost-ensemble-coverage WARNING path: exit stays 0.)
+    # (--no-ensemble/--no-supervised: those payloads proved themselves
+    # on the cold leg above; rerunning them would spend tier-1 budget
+    # re-verifying the same pipeline. Gating warm-vs-cold below
+    # therefore also covers the lost-ensemble- and lost-resilience-
+    # coverage WARNING paths: exit stays 0.)
     out2 = str(tmp_path / "bench_results_warm")
-    res2 = run_smoke(out2, "--no-ensemble")
+    res2 = run_smoke(out2, "--no-ensemble", "--no-supervised")
     assert res2.returncode == 0, res2.stderr[-2000:]
     warm = json.load(open(os.path.join(out2, "perf_report.json")))
     warm_cs = warm["cold_start"]
@@ -654,7 +677,11 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     # inflated bar (observed: MAD ~half the median under a loaded
     # tier-1 run). A constant shift keeps the measured jitter honest
     # while the +300% delta is unambiguous at any plausible MAD.
-    slow = dict(rep)
+    # (`resilience` is stripped first: the real smoke report records
+    # the supervised drill's incident, and a regression measured
+    # across a recorded incident is — by design — annotated instead of
+    # gated; the degraded-annotation acceptance case follows below.)
+    slow = {k: v for k, v in rep.items() if k != "resilience"}
     slow["samples_ms"] = [x + 3.0 * rep["steps"]["p50_ms"]
                           for x in rep["samples_ms"]]
     slow["steps"] = ledger.step_stats(slow["samples_ms"])
@@ -663,13 +690,52 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     res = run_gate("--baseline", report_path, "--current", slow_path)
     assert res.returncode == 1, (res.stdout, res.stderr[-2000:])
 
+    # the SAME degradation with the smoke run's real resilience
+    # section kept: its single incident is a harness DRILL
+    # (faults_injected covers it, and the drill runs outside the timed
+    # window), so the regression verdict stays ARMED — exit 1 — while
+    # the verdict is still annotated degraded. The ever-present smoke
+    # drill must not disarm CI; the REAL-incident softening path is
+    # pinned in tests/test_resilience.py. Driven in-process (same
+    # argparse -> verdict -> exit path as the subprocess runs, without
+    # another interpreter + jax startup against the tier-1 budget).
+    slow_deg = dict(slow)
+    slow_deg["resilience"] = rep["resilience"]
+    assert rep["resilience"]["faults_injected"] == 1
+    slow_deg_path = str(tmp_path / "slow_degraded.json")
+    json.dump(slow_deg, open(slow_deg_path, "w"))
+    assert gate.main(["--baseline", report_path,
+                      "--current", slow_deg_path]) == 1
+    capsys.readouterr()
+    deg_verdict = gate.compare_reports(rep, slow_deg)
+    assert deg_verdict["exit_code"] == 1
+    assert deg_verdict["degraded"] is True
+    assert any("drill" in w for w in deg_verdict["warnings"])
+    # ... and the PR acceptance: the smoke report CARRYING its drill
+    # incident is accepted-with-degraded-annotation on a clean
+    # comparison — never refused for merely recording an incident
+    self_verdict = gate.compare_reports(rep, rep)
+    assert self_verdict["exit_code"] == 0
+    assert self_verdict["degraded"] is True
+    assert any("recorded incident" in w for w in self_verdict["warnings"])
+
     # synthetic contamination burst -> invalid evidence (the detector
     # is forced on: auto-mode skips it for CPU reports, where scheduler
-    # stalls are legitimate)
-    cont = dict(rep)
+    # stalls are legitimate; resilience stripped — with a recorded
+    # incident the same burst would be annotated, not refused, which
+    # tests/test_resilience.py pins). The burst is ADDITIVE for the
+    # same reason the degradation synthetic above is: a noisy tier-1
+    # host inflates the run's MAD and with it the outlier threshold
+    # (median + max(5·1.4826·MAD, 0.25·median)), so a multiplicative
+    # 5x burst can land under its own inflated bar (observed once in a
+    # loaded suite run); +6·median +10·MAD clears the threshold at any
+    # plausible noise level.
+    cont = {k: v for k, v in rep.items() if k != "resilience"}
     samples = rep["samples_ms"] * 3
+    bump = (6.0 * rep["steps"]["p50_ms"]
+            + 10.0 * (rep["steps"]["mad_ms"] or 0.0))
     for i in range(12, 18):
-        samples[i] *= 5.0
+        samples[i] += bump
     cont["samples_ms"] = samples
     cont["steps"] = ledger.step_stats(samples)
     cont_path = str(tmp_path / "cont.json")

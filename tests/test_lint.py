@@ -245,6 +245,9 @@ def test_cli_clean_repo():
 
 # -- donation satellite ----------------------------------------------------
 
+@pytest.mark.slow  # ~19 s interpret-mode; tier-1 keeps donation
+# correctness via test_donation_roundoff_exact_generic (XLA tier) and
+# the smoke e2e's donated step + lint donation audit
 def test_donation_bit_exact_fused():
     """donate=True must not change a single bit of the FUSED stepper's
     output: the Pallas kernels materialize their outputs, so donation
