@@ -924,6 +924,15 @@ def run_smoke(argv=None):
                         "injected mid-run device-loss fault, completed "
                         "via restore-from-last-good — the report's "
                         "`resilience` section derives from it")
+    p.add_argument("--no-spectra", action="store_true",
+                   help="skip the sharded-spectra payload: a 16^3 "
+                        "2-field power spectrum on the 8-device "
+                        "(2,2,2) mesh with the pencil FFT tier FORCED "
+                        "(fourier.pencil: explicit all_to_all "
+                        "transposes inside shard_map, one fused "
+                        "dispatch), the report's `fft` section and the "
+                        "lint collective audit of the spectra program "
+                        "derive from it")
     args = p.parse_args(argv)
 
     import contextlib
@@ -1047,10 +1056,42 @@ def run_smoke(argv=None):
     else:
         hb("smoke: <4 devices — skipping the overlapped-halo payload")
 
+    # sharded-spectra payload (pencil tier FORCED): a 2-field 16^3
+    # power spectrum on the full 8-device (2,2,2) mesh — the transform
+    # runs as per-axis local FFT stages with explicit all_to_all
+    # transposes inside shard_map, fused with the |f(k)|^2 weighting
+    # and the per-device binning into ONE dispatch. Compiled before the
+    # capture; the timed calls run inside it so the fft_stage /
+    # fft_transpose scopes land in trace_summary and the ledger's
+    # `fft` section can derive its per-stage rows. Degrades to a note
+    # below 8 devices (the pencil tier needs 16 % ndev == 0).
+    spectra_seg = None
+    if not args.no_spectra and len(jax.devices()) >= 8 \
+            and 16 % len(jax.devices()[:8]) == 0:
+        try:
+            sdec = ps.DomainDecomposition((2, 2, 2),
+                                          devices=jax.devices()[:8])
+            sgrid = (16, 16, 16)
+            slat = ps.Lattice(sgrid, (5.0,) * 3, dtype=np.float32)
+            sfft = ps.make_dft(sdec, grid_shape=sgrid, dtype=np.float32,
+                               scheme="pencil")
+            sspec = ps.PowerSpectra(sdec, sfft, slat.dk, slat.volume)
+            sfx = sdec.shard(np.random.default_rng(29).standard_normal(
+                (2,) + sgrid).astype(np.float32))
+            sspec(sfx)  # compile outside the capture window
+            spectra_seg = (sdec, sfft, sspec, sfx, sgrid)
+        except Exception as e:  # noqa: BLE001 — record, never kill smoke
+            hb(f"smoke: sharded-spectra payload failed to build: "
+               f"{type(e).__name__}: {e}")
+            traceback.print_exc()
+    elif not args.no_spectra:
+        hb("smoke: <8 devices — skipping the sharded-spectra payload")
+
     steptimer = ps.StepTimer(report_every=float("inf"), emit_steps=True)
     capture = (contextlib.nullcontext() if args.no_profile else
                obs.trace.capture(os.path.join(args.out, "smoke_trace"),
                                  label="smoke"))
+    spectra_times = []
     with capture:
         steptimer.tick()  # arm the clock
         for i in range(args.steps):
@@ -1065,6 +1106,13 @@ def run_smoke(argv=None):
             for _ in range(6):
                 with obs.trace_scope("halo_overlap"):
                     sync(ofd.lap(ox))
+        if spectra_seg is not None:
+            _, _, sspec, sfx, _ = spectra_seg
+            for _ in range(4):
+                t0_spec = time.perf_counter()
+                sspec(sfx)  # host histogram: call is synchronous
+                spectra_times.append(
+                    (time.perf_counter() - t0_spec) * 1e3)
 
     # drain the sentinel queue: the trailing <4 health vectors land in
     # the event log before the ledger ingests it
@@ -1077,6 +1125,21 @@ def run_smoke(argv=None):
         obs.emit("halo_traffic",
                  bytes_per_step=overlap_seg[0].traced_halo_bytes(),
                  label="smoke-overlap")
+
+    if spectra_seg is not None and spectra_times:
+        # the ledger's `fft` section derives from these: per-call
+        # spectra_time samples plus one fft_spectra leg record (scheme,
+        # grid, field count -> the 5 N log2 N flops model)
+        _, sfft, sspec, sfx, sgrid = spectra_seg
+        for ms in spectra_times:
+            obs.emit("spectra_time", ms=ms, label="smoke-spectra")
+        ms_p50 = sorted(spectra_times)[len(spectra_times) // 2]
+        obs.emit("fft_spectra", scheme=sfft.scheme,
+                 grid_shape=list(sgrid), nfields=2,
+                 calls=len(spectra_times), ms_per_call=ms_p50,
+                 complex_itemsize=8, label="smoke-spectra")
+        hb(f"smoke: sharded spectra ({sfft.scheme}) p50 "
+           f"{ms_p50:.2f} ms/call over {len(spectra_times)} call(s)")
 
     # ensemble payload: a batched scenario population (8 members x 16^3
     # packed along the ensemble mesh axis) through the EnsembleDriver
@@ -1271,6 +1334,32 @@ def run_smoke(argv=None):
             checker="graph-build", where="smoke_step", severity="warning",
             message=f"IR audit of the smoke step failed: "
                     f"{type(e).__name__}: {e}")])
+    if spectra_seg is not None:
+        # the spectral-tier acceptance pin: the compiled pencil-spectra
+        # program may carry ONLY the allowlisted all_to_all transposes
+        # — an all-gather of a field-sized operand there means the
+        # transform replicated, the cliff the tier exists to remove
+        try:
+            from pystella_tpu.lint.targets import TRANSPOSE_COLLECTIVES
+            _, _, sspec, sfx, _ = spectra_seg
+            sfn, sk_args = sspec.spectrum_program(outer_shape=(2,),
+                                                  k_power=3)
+            s_asm, s_hlo = _lint.lower_and_compile(
+                sfn, (sfx,) + sk_args)
+            s_viol, s_stats = _lint.audit_artifacts(
+                "smoke_spectra", s_asm, s_hlo,
+                dtype_policy=_lint.POLICY_SPECTRAL_F32,
+                collectives=dict(TRANSPOSE_COLLECTIVES),
+                fused_scopes=("fft_stage", "fft_transpose"))
+            lint_rep.extend(s_viol)
+            lint_rep.graph = {**(lint_rep.graph or {}),
+                              "smoke_spectra": s_stats}
+        except Exception as e:  # noqa: BLE001 — record, never kill it
+            lint_rep.extend([_lint.Violation(
+                checker="graph-build", where="smoke_spectra",
+                severity="warning",
+                message=f"IR audit of the spectra program failed: "
+                        f"{type(e).__name__}: {e}")])
     lint_path = lint_rep.write(os.path.join(args.out, "lint_report.json"))
     lint_summary = lint_rep.summary()
     hb(f"smoke: lint {'PASS' if lint_rep.ok else 'FAIL'} "

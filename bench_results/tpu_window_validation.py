@@ -30,7 +30,16 @@ costs one leg, not the window):
    replay, and a bit-consistency pin against an uninterrupted run —
    recording the on-hardware MTTR and the checkpoint durability-
    barrier overhead that CPU rehearsal cannot measure.
-6. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
+6. ``spectral``     — PR 10: the sharded-spectra leg. Power spectra of
+   a 2-field 256³ (then 512³, budget permitting) lattice through the
+   fully distributed pencil-FFT tier (``fourier.pencil``: explicit
+   all_to_all transposes inside shard_map, one fused dispatch) on the
+   whole held mesh, recording ms/call against the 241 ms/call
+   gw-spectra-256³ single-chip baseline (BENCH_r04, cached TPU
+   session) — the number the spectral tier exists to beat — plus the
+   ``fft`` ledger section's per-stage/transpose split from a profiler
+   capture of the calls.
+7. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
    wires a FRESH ``PYSTELLA_COMPILE_CACHE_DIR``, builds the 512³
    multigrid + preheat step programs cold (recording
    time-to-first-step and the trace/compile split), and AOT-exports
@@ -260,6 +269,81 @@ def worker_elastic(dry_run):
                  and bit_ok) else 1
 
 
+#: the cached-hardware gw-spectra-256^3 figure the spectral leg holds
+#: itself against (BENCH_r04: single-chip replicate/local transform)
+SPECTRA_BASELINE_MS = 241.0
+
+
+def worker_spectral(dry_run):
+    """Sharded pencil-FFT spectra on the held mesh: 2-field power
+    spectra at 256^3 (and 512^3 when the budget allows) through
+    ``make_dft(scheme='pencil')``, the ms/call recorded against the
+    241 ms cached single-chip baseline; a profiler capture of the
+    timed calls populates the ledger's ``fft`` section (per-stage
+    rows, transpose exposed-vs-hidden, flops-model roofline)."""
+    backend, ndev, dial_s = _dial(dry_run)
+    import numpy as np
+    sys.path.insert(0, REPO)
+    import pystella_tpu as ps
+    from pystella_tpu import obs
+    from pystella_tpu.obs.ledger import PerfLedger
+
+    events_path = os.path.join(OUT, "tpu_window_events.jsonl")
+    obs.configure(events_path)
+    obs.ensure_compilation_cache(
+        os.path.join(OUT, "tpu_window_xla_cache"))
+    grids = (32,) if dry_run else (256, 512)
+    rc = 0
+    for n in grids:
+        if n % ndev:
+            record("spectral", backend=backend, ndevices=ndev, grid=n,
+                   skipped=f"{n} % {ndev} != 0 (pencil infeasible)")
+            continue
+        grid = (n, n, n)
+        # all devices along x: the pencil tier redistributes over the
+        # combined axes anyway, and a 1-axis mesh keeps the home
+        # blocks contiguous slabs
+        decomp = ps.DomainDecomposition((ndev, 1, 1))
+        lat = ps.Lattice(grid, (5.0,) * 3, dtype=np.float32)
+        fft = ps.make_dft(decomp, grid_shape=grid, dtype=np.float32,
+                          scheme="pencil")
+        spectra = ps.PowerSpectra(decomp, fft, lat.dk, lat.volume)
+        rng = np.random.default_rng(5)
+        fx = decomp.shard(
+            rng.standard_normal((2,) + grid).astype(np.float32))
+        spectra(fx)  # compile
+        nreps = 3 if dry_run else 5
+        times = []
+        with obs.trace.capture(
+                os.path.join(OUT, "tpu_window_spectral_trace"),
+                label=f"spectral-{n}"):
+            for _ in range(nreps):
+                t0 = time.perf_counter()
+                spectra(fx)
+                times.append((time.perf_counter() - t0) * 1e3)
+        ms = sorted(times)[len(times) // 2]
+        for t_ms in times:
+            obs.emit("spectra_time", ms=t_ms, label=f"spectral-{n}")
+        obs.emit("fft_spectra", scheme=fft.scheme,
+                 grid_shape=list(grid), nfields=2, calls=nreps,
+                 ms_per_call=ms, complex_itemsize=8,
+                 label=f"spectral-{n}")
+        led = PerfLedger.from_events(events_path,
+                                     label=f"spectral-{n}")
+        ffs = led.fft() or {}
+        record("spectral", backend=backend, ndevices=ndev, grid=n,
+               scheme=fft.scheme, dial_s=round(dial_s, 2),
+               ms_per_call=round(ms, 3),
+               baseline_ms=SPECTRA_BASELINE_MS,
+               vs_baseline=(round(SPECTRA_BASELINE_MS / ms, 2)
+                            if n == 256 and ms > 0 else None),
+               transpose_exposed_ms=ffs.get("transpose_exposed_ms"),
+               transpose_hidden_ms=ffs.get("transpose_hidden_ms"))
+        if not (ms > 0):
+            rc = 1
+    return rc
+
+
 def worker_cold_start(dry_run, phase):
     """phase='cold': fresh cache, build + time everything, probe
     donation safety, export AOT artifacts. phase='warm': re-dial
@@ -362,7 +446,8 @@ def worker_cold_start(dry_run, phase):
 def main():
     p = argparse.ArgumentParser(prog="tpu_window_validation.py")
     p.add_argument("--legs", default="perf_trace,overlap,lint_tpu,"
-                                     "ensemble,elastic,cold_start",
+                                     "ensemble,elastic,spectral,"
+                                     "cold_start",
                    help="comma-separated legs, priority order")
     p.add_argument("--dry-run", action="store_true",
                    help="CPU + tiny grids: rehearse the plumbing")
@@ -377,7 +462,8 @@ def main():
               "overlap": worker_overlap,
               "lint_tpu": worker_lint_tpu,
               "ensemble": worker_ensemble,
-              "elastic": worker_elastic}.get(args.worker)
+              "elastic": worker_elastic,
+              "spectral": worker_spectral}.get(args.worker)
         if fn is not None:
             return fn(args.dry_run)
         if args.worker == "cold_start":

@@ -30,11 +30,12 @@ from pystella_tpu.ops import (
     expand_stencil, centered_diff,
     Reduction, FieldStatistics,
     Histogrammer, FieldHistogrammer,
+    FFTStencil, fft_laplacian, use_fft_stencil,
 )
 from pystella_tpu.ops.pallas_stencil import StreamingStencil
 from pystella_tpu.ops.fused import FusedScalarStepper, FusedPreheatStepper
 from pystella_tpu.fourier import (
-    DFT, fftfreq, pfftfreq, make_hermitian,
+    DFT, PencilFFT, make_dft, fftfreq, pfftfreq, make_hermitian,
     Projector, PowerSpectra, RayleighGenerator,
     SpectralCollocator, SpectralPoissonSolver,
 )
@@ -104,7 +105,9 @@ __all__ = [
     "FiniteDifferencer",
     "Reduction", "FieldStatistics", "Histogrammer", "FieldHistogrammer",
     "StreamingStencil", "FusedScalarStepper", "FusedPreheatStepper",
-    "DFT", "fftfreq", "pfftfreq", "make_hermitian",
+    "FFTStencil", "fft_laplacian", "use_fft_stencil",
+    "DFT", "PencilFFT", "make_dft", "fftfreq", "pfftfreq",
+    "make_hermitian",
     "Projector", "PowerSpectra", "RayleighGenerator",
     "SpectralCollocator", "SpectralPoissonSolver",
     "Sector", "ScalarSector", "TensorPerturbationSector", "tensor_index",

@@ -195,6 +195,30 @@ register("PYSTELLA_RESILIENCE_RETRY_BUDGET_S", default="600",
          help="wall budget in seconds for ONE incident's recovery "
               "attempts (re-dial + restore retries); exhausting it "
               "raises RecoveryFailed with the last underlying error")
+register("PYSTELLA_FFT_SCHEME", default="auto",
+         help="distributed-FFT scheme the planner (fourier.plan."
+              "make_dft) and the spectra/projector/Poisson consumers "
+              "select: 'auto' (the shard_map pencil tier whenever the "
+              "grid x/y axes divide the total device count, else the "
+              "DFT reshard/partial/replicate chain), 'pencil' (force "
+              "the shard_map tier; infeasible shapes raise), or 'dft' "
+              "(force the legacy declarative-reshard tiering)")
+register("PYSTELLA_FFT_REPLICATE_LIMIT", default="1073741824",
+         kind="float",
+         help="replicate-fallback size limit in bytes for transforms "
+              "no distributed scheme serves: above it DFT construction "
+              "raises instead of silently replicating the k-space "
+              "array on every device (override per-instance with "
+              "replicate_limit=/allow_replicate=)")
+register("PYSTELLA_FFT_STENCIL", default="auto",
+         help="FFT-stencil fast-path policy (ops.fft_stencil."
+              "use_fft_stencil): 1/0 force the k-space/direct path, "
+              "unset/'auto' decides by the flops crossover model "
+              "(direct tap cost vs 2 x 5 N log2 N transform cost)")
+register("PYSTELLA_FFT_STENCIL_CROSSOVER", default="1.5", kind="float",
+         help="direct-to-FFT flops ratio the auto FFT-stencil policy "
+              "requires before taking the k-space path (margin for the "
+              "transpose traffic the flops model does not see)")
 
 # ---------------------------------------------------------------------------
 # driver knobs (bench.py / bench_scaling.py / examples)

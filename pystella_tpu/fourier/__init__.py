@@ -2,6 +2,8 @@ from pystella_tpu.fourier.dft import (
     DFT, fftfreq, pfftfreq, make_hermitian, get_sliced_momenta,
     get_real_dtype_with_matching_prec, get_complex_dtype_with_matching_prec,
 )
+from pystella_tpu.fourier.pencil import PencilFFT, pencil_feasible
+from pystella_tpu.fourier.plan import make_dft, ensure_spectral_fft
 from pystella_tpu.fourier.projectors import Projector, tensor_index
 from pystella_tpu.fourier.spectra import PowerSpectra
 from pystella_tpu.fourier.rayleigh import RayleighGenerator
@@ -9,7 +11,9 @@ from pystella_tpu.fourier.derivs import SpectralCollocator
 from pystella_tpu.fourier.poisson import SpectralPoissonSolver
 
 __all__ = [
-    "DFT", "fftfreq", "pfftfreq", "make_hermitian", "get_sliced_momenta",
+    "DFT", "PencilFFT", "pencil_feasible", "make_dft",
+    "ensure_spectral_fft",
+    "fftfreq", "pfftfreq", "make_hermitian", "get_sliced_momenta",
     "get_real_dtype_with_matching_prec",
     "get_complex_dtype_with_matching_prec",
     "Projector", "tensor_index", "PowerSpectra", "RayleighGenerator",

@@ -31,7 +31,9 @@ class SpectralCollocator:
         self.decomp = fft.decomp
         rdtype = fft.rdtype
 
-        decomp = fft.decomp
+        # momentum arrays in the transform's own k layout
+        # (fft.k_axis_array): the multiplies stay elementwise on the
+        # pencil tier's natural layout too
         self._k1 = []  # first-derivative momenta (zero & Nyquist zeroed)
         self._k2 = []  # second-derivative momenta
         for mu, kk in enumerate(fft.sub_k.values()):
@@ -40,8 +42,8 @@ class SpectralCollocator:
             k1 = k2.copy()
             k1[np.abs(kk_int) == fft.grid_shape[mu] // 2] = 0.0
             k1[kk_int == 0] = 0.0
-            self._k1.append(decomp.axis_array(mu, k1, sharded=(mu != 2)))
-            self._k2.append(decomp.axis_array(mu, k2, sharded=(mu != 2)))
+            self._k1.append(fft.k_axis_array(mu, k1))
+            self._k2.append(fft.k_axis_array(mu, k2))
 
         self._lap = jax.jit(self._lap_impl)
         self._grad = jax.jit(self._grad_impl)

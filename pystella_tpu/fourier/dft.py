@@ -181,8 +181,14 @@ class DFT:
         self._z_sharded = pz > 1
         # pop the replicate-tier options unconditionally so they are
         # consumed (not silently swallowed) whichever scheme is selected
-        # (ADVICE r4)
-        replicate_limit = float(kwargs.pop("replicate_limit", 2**30))
+        # (ADVICE r4); the limit default is env-tunable so a production
+        # deployment can tighten it fleet-wide
+        replicate_limit = kwargs.pop("replicate_limit", None)
+        if replicate_limit is None:
+            from pystella_tpu import config as _config
+            replicate_limit = _config.get_float(
+                "PYSTELLA_FFT_REPLICATE_LIMIT")
+        replicate_limit = float(replicate_limit)
         allow_replicate = bool(kwargs.pop("allow_replicate", False))
         if kwargs:
             import warnings
@@ -197,11 +203,17 @@ class DFT:
             logger.info(
                 "DFT %s on %d devices: using the partial-replication "
                 "pencil scheme (per-stage long axis sharded by one mesh "
-                "axis; transient memory ~%d x the home block)",
+                "axis; transient memory ~%d x the home block). The "
+                "fully distributed pencil tier (fourier.pencil) needs "
+                "grid x AND y divisible by the total device count.",
                 self.grid_shape, nproc, max(px, py))
         else:
             self._scheme = "replicate"
-            nbytes = (int(np.prod(self.grid_shape))
+            # size the k-space array the fallback would replicate: for
+            # r2c transforms that is the HALF spectrum (Nz//2+1), not
+            # the full grid — the old full-grid figure overstated r2c
+            # by ~2x and refused shapes whose replicas actually fit
+            nbytes = (int(np.prod(self.shape(True)))
                       * np.dtype(self.cdtype).itemsize)
             if nproc > 1 and not allow_replicate \
                     and nbytes > replicate_limit:
@@ -211,17 +223,24 @@ class DFT:
                     f"divide the mesh axes) and the k-space array "
                     f"(~{nbytes / 2**30:.1f} GiB) exceeds the "
                     "replicate-fallback limit — every device would hold "
-                    "and transform the FULL array. Choose divisible "
-                    "grid/mesh shapes (pystella_tpu.advise_shapes lists "
-                    "which meshes keep a distributed scheme), or pass "
-                    "allow_replicate=True / a larger replicate_limit to "
-                    "accept the cost")
+                    "and transform the FULL array. Prefer grid x/y "
+                    "axes divisible by the total device count, which "
+                    "enable the fully distributed pencil tier "
+                    "(pystella_tpu.make_dft / fourier.pencil — no "
+                    "replication at any size); per-mesh-axis "
+                    "divisibility enables the partial tier. "
+                    "pystella_tpu.advise_shapes(grid_shape, n_devices) "
+                    "lists which meshes keep a distributed scheme. As "
+                    "a last resort pass allow_replicate=True / a "
+                    "larger replicate_limit "
+                    "(PYSTELLA_FFT_REPLICATE_LIMIT) to accept the cost")
             if nproc > 1:
                 logger.warning(
                     "DFT %s on %d devices: grid axes do not divide the "
                     "mesh axes — transforms will REPLICATE the array on "
                     "every device and run redundantly (correct, but "
-                    "wasteful). Choose divisible grid/mesh shapes.",
+                    "wasteful). Choose grid x/y divisible by the device "
+                    "count for the distributed pencil tier.",
                     self.grid_shape, nproc)
         self._pencil_ok = self._scheme != "replicate"
 
@@ -236,15 +255,18 @@ class DFT:
                       in zip(("momenta_x", "momenta_y", "momenta_z"), k)}
 
         # device copies shaped for broadcasting against k-space arrays,
-        # sharded to match their lattice axes (k-space keeps z unsharded)
-        self.sub_k_device = [decomp.axis_array(mu, ki, sharded=(mu != 2))
+        # in THIS transform's k layout: k_axis_array and _dft_impl/
+        # _idft_impl resolve through the subclass, so one constructor
+        # serves every tier (the pencil tier's natural layout included)
+        self.sub_k_device = [self.k_axis_array(mu, ki)
                              for mu, ki in enumerate(k)]
 
         from pystella_tpu.obs import memory as _obs_memory
+        fwd_label, inv_label = self._jit_labels()
         self._dft = _obs_memory.instrument_jit(
-            jax.jit(self._dft_impl), label="dft.forward")
+            jax.jit(self._dft_impl), label=fwd_label)
         self._idft = _obs_memory.instrument_jit(
-            jax.jit(self._idft_impl), label="dft.inverse")
+            jax.jit(self._idft_impl), label=inv_label)
 
     def shape(self, forward_output=True):
         """Global array shape (reference dft.py:124-133 reports per-rank
@@ -258,6 +280,31 @@ class DFT:
         """k-space axes are not permuted relative to position space (XLA
         transposes internally and restores layout; cf. dft.py:412-417)."""
         return tuple(range(len(self.grid_shape)))
+
+    #: True on the fully distributed shard_map pencil tier
+    #: (:class:`pystella_tpu.fourier.pencil.PencilFFT`)
+    is_pencil = False
+
+    @property
+    def scheme(self):
+        """The selected transform scheme name (``"pencil"``/``"partial"``/
+        ``"replicate"`` for this declarative-reshard class; the
+        shard_map tier reports ``"pencil-a2a"``)."""
+        return self._scheme
+
+    def k_axis_array(self, mu, values):
+        """Per-axis k-space constants (momenta, stencil eigenvalues)
+        shaped for broadcasting against this transform's k-space
+        arrays, sharded to match THEIR layout along lattice axis ``mu``
+        — the one hook projector/Poisson/collocator constants go
+        through, so every consumer works against any transform tier
+        (the pencil tier keeps x local and shards y over the combined
+        mesh axes, unlike this class's x/y home layout)."""
+        return self.decomp.axis_array(mu, values, sharded=(mu != 2))
+
+    def _jit_labels(self):
+        """Compile-ledger labels for the forward/inverse jits."""
+        return "dft.forward", "dft.inverse"
 
     # -- pencil transforms -------------------------------------------------
     #

@@ -28,15 +28,17 @@ class SpectralPoissonSolver:
         an h-order FD Laplacian, or ``lambda k, dx: -k**2`` for spectral.
     """
 
-    def __init__(self, fft, dk, dx, effective_k):
+    def __init__(self, fft, dk, dx, effective_k, scheme=None):
+        from pystella_tpu.fourier.plan import ensure_spectral_fft
+        fft = ensure_spectral_fft(fft, scheme)
         self.fft = fft
         rdtype = fft.rdtype
 
-        decomp = fft.decomp
+        # eigenvalue arrays in the transform's own k layout
+        # (fft.k_axis_array) — elementwise solve on any tier
         self._eig = [
-            decomp.axis_array(mu, np.asarray(
-                effective_k(dk[mu] * kk.astype(rdtype), dx[mu]), rdtype),
-                sharded=(mu != 2))
+            fft.k_axis_array(mu, np.asarray(
+                effective_k(dk[mu] * kk.astype(rdtype), dx[mu]), rdtype))
             for mu, kk in enumerate(fft.sub_k.values())]
 
         def solve(rho, m_squared):

@@ -33,9 +33,17 @@ class Projector:
         eigenvalues; ``0`` means continuum momenta.
     :arg dk: momentum-space grid spacing per axis.
     :arg dx: position-space grid spacing per axis.
+    :arg scheme: transform-scheme override
+        (:func:`~pystella_tpu.fourier.plan.ensure_spectral_fft`):
+        ``"pencil"`` rebuilds the transform on the fully distributed
+        pencil tier; projections are elementwise in k-space, so with
+        the momentum constants in the transform's own layout (below)
+        the TT-projection runs shard-local on any tier.
     """
 
-    def __init__(self, fft, effective_k, dk, dx):
+    def __init__(self, fft, effective_k, dk, dx, scheme=None):
+        from pystella_tpu.fourier.plan import ensure_spectral_fft
+        fft = ensure_spectral_fft(fft, scheme)
         self.fft = fft
 
         if not callable(effective_k):
@@ -47,11 +55,13 @@ class Projector:
                 def effective_k(k, dx):  # noqa: ARG001
                     return k
 
-        decomp = fft.decomp
         rdtype = fft.rdtype
 
         # stencil-effective momenta with zero & Nyquist modes zeroed
-        # (reference projectors.py:77-86)
+        # (reference projectors.py:77-86), placed in the TRANSFORM'S
+        # k-space layout (fft.k_axis_array) so projections stay
+        # elementwise/shard-local on every tier — the pencil tier keeps
+        # x local and shards y over the combined mesh axes
         self.eff_mom = {}
         self._eff_dev = []
         for mu, (name, kk) in enumerate(zip(
@@ -63,8 +73,7 @@ class Projector:
             eff[np.abs(kk_int) == fft.grid_shape[mu] // 2] = 0.0
             eff[kk_int == 0] = 0.0
             self.eff_mom[name] = eff
-            self._eff_dev.append(
-                decomp.axis_array(mu, eff, sharded=(mu != 2)))
+            self._eff_dev.append(fft.k_axis_array(mu, eff))
 
         self._transversify = jax.jit(self._transversify_impl)
         self._vec_to_pol = jax.jit(self._vec_to_pol_impl)

@@ -36,6 +36,7 @@ from pystella_tpu.lint.report import (LINT_SCHEMA_VERSION, LintReport,
 from pystella_tpu.lint import graph, source
 from pystella_tpu.lint.graph import (GraphTarget, POLICY_BF16_ACC32,
                                      POLICY_F32, POLICY_F64,
+                                     POLICY_SPECTRAL_F32,
                                      audit_artifacts, audit_target,
                                      audit_targets, lower_and_compile)
 from pystella_tpu.lint.source import HOT_MODULES, check_package
@@ -43,6 +44,7 @@ from pystella_tpu.lint.source import HOT_MODULES, check_package
 __all__ = [
     "LINT_SCHEMA_VERSION", "LintReport", "Violation",
     "GraphTarget", "POLICY_F32", "POLICY_F64", "POLICY_BF16_ACC32",
+    "POLICY_SPECTRAL_F32",
     "audit_artifacts", "audit_target", "audit_targets",
     "lower_and_compile", "HOT_MODULES", "check_package",
     "run_lint", "package_dir", "doc_path",

@@ -47,6 +47,7 @@ import re
 from pystella_tpu.lint.report import Violation
 
 __all__ = ["POLICY_F32", "POLICY_F64", "POLICY_BF16_ACC32",
+           "POLICY_SPECTRAL_F32",
            "GraphTarget", "audit_artifacts", "audit_target",
            "audit_targets", "lower_and_compile", "parse_main_params",
            "tensor_nbytes"]
@@ -80,6 +81,15 @@ POLICY_F64 = {
 POLICY_BF16_ACC32 = {
     "name": "bf16-in/f32-acc",
     "allow_floats": ("bf16", "f32"),
+}
+
+#: the f32 spectral programs (pencil FFT + binning): complex64 is the
+#: transform's working type and is allowed; complex128/f64 still
+#: violate (the classic x64 upcast doubling transpose traffic)
+POLICY_SPECTRAL_F32 = {
+    "name": "f32-spectral",
+    "allow_floats": ("f32", "f16", "bf16", "f8e4m3fn", "f8e5m2",
+                     "complex<f32>"),
 }
 
 #: collective base op names recognized in compiled HLO

@@ -18,7 +18,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from pystella_tpu.lint.graph import POLICY_F32, GraphTarget
+from pystella_tpu.lint.graph import (POLICY_F32, POLICY_SPECTRAL_F32,
+                                     GraphTarget)
 
 __all__ = ["default_targets", "targets_by_name", "GRID"]
 
@@ -36,6 +37,15 @@ HALO_COLLECTIVES = {
 REDUCTION_COLLECTIVES = {
     "all-reduce": "registered in-graph reductions (obs.sentinel health "
                   "vector, fused energy sums)",
+}
+
+#: the pencil-FFT stage redistributions are explicit all_to_alls — the
+#: ONLY collective a sharded spectral program is allowed to carry: an
+#: all-gather there means the transform replicated a field-sized
+#: operand, exactly the cliff the pencil tier exists to remove
+TRANSPOSE_COLLECTIVES = {
+    "all-to-all": "pencil-FFT transposes (fourier.pencil per-stage "
+                  "redistributions inside shard_map)",
 }
 
 
@@ -214,6 +224,34 @@ def build_ensemble_step(size=4):
     return fn, (batch, t_vec, dt_vec, bargs, {}), {}, batch
 
 
+def build_sharded_spectra():
+    """The pencil-tier spectra program on a sharded mesh: ONE jitted
+    module from the position-space fields to per-device partial bin
+    sums — the distributed r2c transform (explicit all_to_all
+    transposes), the ``counts·|k|³·|f(k)|²`` weighting, and the
+    chunked shard-local bincount. Auditing it pins the acceptance
+    contract of the spectral tier: the compiled module's only
+    collectives are the allowlisted transposes — no all-gather of a
+    field-sized operand anywhere in the spectra program — and no f64
+    leaked into the f32 pipeline (complex64 is the transform's working
+    type, POLICY_SPECTRAL_F32)."""
+    import jax
+    import pystella_tpu as ps
+    decomp = _mesh_decomp(want_sharded=True)
+    lattice = ps.Lattice(GRID, (5.0, 5.0, 5.0), dtype=np.float32)
+    # force the pencil tier on the sharded mesh (GRID divides the
+    # 4-device count); the <4-device fallback audits the local path
+    nproc = int(np.prod(decomp.proc_shape))
+    fft = ps.make_dft(decomp, grid_shape=GRID, dtype=np.float32,
+                      scheme="pencil" if nproc > 1 else "auto")
+    spectra = ps.PowerSpectra(decomp, fft, lattice.dk, lattice.volume)
+    fn, k_args = spectra.spectrum_program(outer_shape=(2,), k_power=3)
+    rng = np.random.default_rng(17)
+    fx = decomp.shard(
+        1e-3 * rng.standard_normal((2,) + GRID).astype(np.float32))
+    return fn, (fx,) + k_args, {}, None
+
+
 def build_mg_smooth():
     """The multigrid V-cycle's hot kernel: a level-0 Jacobi smooth on a
     sharded mesh (the compiled body every cycle dispatches most)."""
@@ -306,5 +344,15 @@ def default_targets():
             dtype_policy=POLICY_F32,
             collectives=dict(HALO_COLLECTIVES),
             fused_scopes=("mg_smooth",),
+        ),
+        GraphTarget(
+            name="sharded_spectra",
+            build=build_sharded_spectra,
+            dtype_policy=POLICY_SPECTRAL_F32,
+            # ONLY the pencil transposes: an all-gather of a
+            # field-sized operand in the spectra program is exactly
+            # the replication hazard the distributed tier removes
+            collectives=dict(TRANSPOSE_COLLECTIVES),
+            fused_scopes=("fft_stage",),
         ),
     ]

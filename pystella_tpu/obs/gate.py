@@ -17,7 +17,9 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       by both ``cold_start_factor`` and ``cold_start_floor`` seconds —
       or an ENSEMBLE regression: batched member throughput
       (member-steps/s) drops more than ``ensemble_threshold_pct`` below
-      the baseline's
+      the baseline's — or a SPECTRAL regression: the ``fft`` section's
+      spectra p50 ms/call exceeds the baseline's by more than
+      ``fft_threshold_pct``
 2     invalid evidence: the contamination detector flagged the run
       (outlier burst / bimodal step times — the round-5 concurrent-probe
       signature), the report has no step samples, the run DIVERGED (a
@@ -203,7 +205,8 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     check_lint=True, check_cold_start=True,
                     cold_start_factor=1.5, cold_start_floor=5.0,
                     check_ensemble=True, ensemble_threshold_pct=20.0,
-                    check_resilience=True):
+                    check_resilience=True,
+                    check_fft=True, fft_threshold_pct=25.0):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -525,6 +528,9 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     if check_ensemble:
         _compare_ensemble(verdict, baseline, current,
                           threshold_pct=ensemble_threshold_pct)
+    if check_fft:
+        _compare_fft(verdict, baseline, current,
+                     threshold_pct=fft_threshold_pct)
     if check_resilience and (baseline or {}).get("resilience") \
             and not current.get("resilience"):
         verdict["warnings"].append(
@@ -532,6 +538,64 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
             "current run has none — incident/checkpoint coverage was "
             "lost")
     return verdict
+
+
+def _compare_fft(verdict, baseline, current, threshold_pct=25.0):
+    """Spectra-throughput comparison (mutates ``verdict`` in place):
+    the current ``fft.ms.p50_ms`` — the median per-call wall time of
+    the run's spectra outputs (:mod:`pystella_tpu.fourier.pencil`'s
+    report section) — must stay within ``threshold_pct`` of the
+    baseline's. Spectra are the dominant cost of any run that outputs
+    them (the 241 ms/call gw-spectra-256³ headline vs a sub-ms step),
+    so a spectral-tier regression fails CI like a slow step does. The
+    threshold is wider than the step gate's: a spectra call is one
+    sample per output cadence, not thousands per run. Coverage loss
+    (baseline had an ``fft`` section, current does not) degrades to a
+    warning; a scheme CHANGE between reports warns too — a pencil-tier
+    baseline is not a like-for-like baseline for a replicate-tier
+    run."""
+    bff = (baseline or {}).get("fft") or {}
+    cff = current.get("fft") or {}
+    if bff and not cff:
+        verdict["warnings"].append(
+            "fft: baseline carried a spectral (fft) section but the "
+            "current run has none — spectra-throughput coverage was "
+            "lost")
+        return
+    if not bff or not cff:
+        return
+    bs, cs = bff.get("scheme"), cff.get("scheme")
+    if bs is not None and cs is not None and bs != cs:
+        verdict["warnings"].append(
+            f"fft: transform scheme changed between reports (baseline "
+            f"{bs!r} vs current {cs!r}) — spectra times are compared, "
+            "but the tiers move different bytes")
+    b = (bff.get("ms") or {}).get("p50_ms")
+    c = (cff.get("ms") or {}).get("p50_ms")
+    if not isinstance(b, (int, float)) or b <= 0:
+        return
+    if not isinstance(c, (int, float)):
+        verdict["warnings"].append(
+            "fft: baseline tracked a spectra p50 ms/call but the "
+            "current run's fft section carries none — "
+            "spectra-throughput coverage was lost")
+        return
+    slow_pct = 100.0 * (c - b) / b
+    verdict["fft"] = {
+        "baseline_p50_ms": b, "current_p50_ms": c,
+        "slowdown_pct": slow_pct, "threshold_pct": threshold_pct,
+    }
+    if slow_pct > threshold_pct:
+        verdict.update(ok=False, exit_code=max(verdict["exit_code"], 1))
+        verdict["reasons"].append(
+            f"fft regression: spectra p50 {c:.4g} ms/call is "
+            f"{slow_pct:.1f}% above baseline {b:.4g} (threshold "
+            f"{threshold_pct:g}%) — check the fft section's per-stage "
+            "rows and transpose exposed time")
+    elif -slow_pct > threshold_pct:
+        verdict["warnings"].append(
+            f"fft improvement: spectra p50 {-slow_pct:.1f}% below "
+            "baseline — consider refreshing the baseline")
 
 
 def _compare_ensemble(verdict, baseline, current, threshold_pct=20.0):
@@ -744,6 +808,13 @@ def main(argv=None):
                         "baseline before the gate fails (default 20)")
     p.add_argument("--no-ensemble", action="store_true",
                    help="skip the ensemble member-throughput check")
+    p.add_argument("--fft-threshold-pct", type=float, default=25.0,
+                   help="fft: allowed spectra p50 ms/call slowdown vs "
+                        "the baseline before the gate fails (default "
+                        "25)")
+    p.add_argument("--no-fft", action="store_true",
+                   help="skip the spectral-tier (fft section) "
+                        "spectra-throughput check")
     p.add_argument("--no-resilience", action="store_true",
                    help="skip the resilience triage (degraded-fleet "
                         "annotation of regressions/contamination across "
@@ -800,7 +871,9 @@ def main(argv=None):
         cold_start_floor=args.cold_start_floor,
         check_ensemble=not args.no_ensemble,
         ensemble_threshold_pct=args.ensemble_threshold_pct,
-        check_resilience=not args.no_resilience)
+        check_resilience=not args.no_resilience,
+        check_fft=not args.no_fft,
+        fft_threshold_pct=args.fft_threshold_pct)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

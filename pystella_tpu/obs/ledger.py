@@ -251,6 +251,10 @@ class PerfLedger:
         self.durable_steps = []         # checkpoint_durable steps, in order
         self.checkpoint_barrier_s = 0.0  # summed durability-barrier waits
         self.supervisor_runs = []       # supervisor_done payloads, in order
+        self.fft_runs = []              # fft_spectra payloads (driver legs)
+        self.spectra_ms = []            # per-call spectra wall times
+        #                                 (spectra_time events — drivers
+        #                                 emit one per spectra output)
 
     # -- ingestion ---------------------------------------------------------
 
@@ -382,6 +386,17 @@ class PerfLedger:
                         led.checkpoint_barrier_s += float(data["wait_s"])
             elif kind == "supervisor_done":
                 led.supervisor_runs.append(data)
+            elif kind == "fft_spectra":
+                # a driver's sharded-spectra leg totals (scheme, grid,
+                # field count, per-call ms) -> the `fft` report section
+                led.fft_runs.append(data)
+            elif kind == "spectra_time" and isinstance(
+                    data.get("ms"), (int, float)):
+                # one spectra output's wall time — emitted per output
+                # step by the preheating driver (--spectra-cadence), so
+                # spectra cost is a ledger-visible series, not a one-off
+                # microbenchmark
+                led.spectra_ms.append(float(data["ms"]))
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -789,6 +804,109 @@ class PerfLedger:
             },
         }
 
+    def fft(self):
+        """The distributed-spectral-tier summary
+        (:mod:`pystella_tpu.fourier.pencil`): per-call spectra wall
+        times (``spectra_time`` events — the preheating driver emits
+        one per spectra output, a bench leg several per run) folded
+        with the driver's ``fft_spectra`` leg metadata (scheme, grid,
+        field count); a ``5 N log₂ N``-per-field flops model over the
+        median call time (achieved GFLOP/s, and — since distributed
+        FFTs are HBM-bandwidth bound — a traffic model of the three
+        local stages against the device's peak HBM bandwidth, the
+        roofline fraction); and the per-stage scope rows
+        (``fft_stage`` / ``fft_transpose``) with the transposes'
+        exposed-vs-hidden split, derived exactly like the halo
+        overlap's (hidden is bounded by the stage compute available to
+        run concurrently; device rows are fleet sums, normalized
+        per-device). ``None`` when the run carried no spectral
+        telemetry at all."""
+        scopes = self.scopes or {}
+        # prefer the named-scope rows (TPU device traces carry the
+        # scope path); fall back to the raw op rows (`fft.N` /
+        # `all-to-all.N`), which CPU device traces carry instead
+        stage = scopes.get("fft_stage") or scopes.get("fft")
+        transpose = (scopes.get("fft_transpose")
+                     or scopes.get("all-to-all"))
+        samples = list(self.spectra_ms)
+        if not samples:
+            samples = [float(r["ms_per_call"]) for r in self.fft_runs
+                       if isinstance(r.get("ms_per_call"), (int, float))]
+        if not (self.fft_runs or samples or stage or transpose):
+            return None
+        meta = self.fft_runs[-1] if self.fft_runs else {}
+        stats = step_stats(samples)
+
+        model = None
+        shape = meta.get("grid_shape")
+        if isinstance(shape, (list, tuple)) and shape:
+            import math
+            ntot = 1
+            for d in shape:
+                ntot *= int(d)
+            nfields = int(meta.get("nfields") or 1)
+            # r2c forward per field: the standard 5 N log2 N real-FFT
+            # flops model (the roofline numerator the ISSUE pins)
+            flops = nfields * 5 * ntot * math.log2(max(ntot, 2))
+            # traffic floor: each of the 3 local FFT stages reads and
+            # writes the complex field once per field (transposes move
+            # the same bytes again over the interconnect, not HBM).
+            # The complex array is the r2c HALF spectrum — sizing the
+            # full grid would overstate the roofline fraction ~2x, the
+            # same accounting error the DFT replicate limit fixed
+            kelems = ntot
+            if meta.get("real", True) and len(shape) == 3:
+                kelems = (int(shape[0]) * int(shape[1])
+                          * (int(shape[2]) // 2 + 1))
+            itemsize = int(meta.get("complex_itemsize") or 8)
+            traffic = nfields * 3 * 2 * kelems * itemsize
+            model = {"grid_shape": list(shape), "nfields": nfields,
+                     "model_flops": flops,
+                     "model_bytes": traffic,
+                     "achieved_gflops": None,
+                     "achieved_gbps": None,
+                     "peak_gbps": _peak_gbps(self.env.get("device_kind")),
+                     "fraction_of_peak": None}
+            p50 = stats.get("p50_ms")
+            if isinstance(p50, (int, float)) and p50 > 0:
+                model["achieved_gflops"] = flops / (p50 / 1e3) / 1e9
+                model["achieved_gbps"] = traffic / (p50 / 1e3) / 1e9
+                if model["peak_gbps"]:
+                    model["fraction_of_peak"] = (
+                        model["achieved_gbps"] / model["peak_gbps"])
+
+        ndev = self.env.get("num_devices") or 1
+
+        def _row(scope_row):
+            if not scope_row:
+                return None
+            out = dict(scope_row)
+            if isinstance(out.get("total_ms"), (int, float)):
+                out["total_ms_per_device"] = out["total_ms"] / ndev
+            return out
+
+        stage_row = _row(stage)
+        transpose_row = _row(transpose)
+        hidden = exposed = None
+        if transpose_row and isinstance(
+                transpose_row.get("total_ms_per_device"), (int, float)):
+            t_ms = transpose_row["total_ms_per_device"]
+            s_ms = (stage_row or {}).get("total_ms_per_device") or 0.0
+            hidden = min(t_ms, s_ms)
+            exposed = t_ms - hidden
+        return {
+            "scheme": meta.get("scheme"),
+            "calls": len(samples) or None,
+            "ms": stats,
+            "runs": self.fft_runs[:16],
+            "model": model,
+            "stages": {"fft_stage": stage_row,
+                       "fft_transpose": transpose_row},
+            "transpose_hidden_ms": hidden,
+            "transpose_exposed_ms": exposed,
+            "num_devices": ndev,
+        }
+
     # -- report ------------------------------------------------------------
 
     def report(self):
@@ -813,6 +931,7 @@ class PerfLedger:
             "numerics": self.numerics(),
             "ensemble": self.ensemble(),
             "resilience": self.resilience(),
+            "fft": self.fft(),
             "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
@@ -1110,6 +1229,47 @@ def render_markdown(rep):
         for d in (rz.get("degraded") or [])[:4]:
             lines.append(f"- **degraded** at step {d.get('step')}: "
                          f"{d.get('note')}")
+        lines.append("")
+    ff = rep.get("fft")
+    if ff:
+        lines += ["## FFT / spectra", ""]
+        st_f = ff.get("ms") or {}
+        lines.append(
+            f"- scheme `{ff.get('scheme')}`: "
+            f"{_fmt(ff.get('calls'), '.0f', '0')} spectra call(s), p50 "
+            f"{_fmt(st_f.get('p50_ms'))} ms (p90 "
+            f"{_fmt(st_f.get('p90_ms'))}, MAD {_fmt(st_f.get('mad_ms'))})")
+        mo = ff.get("model")
+        if mo:
+            lines.append(
+                f"- flops model (5 N log₂ N × {mo.get('nfields')} "
+                f"field(s) at {mo.get('grid_shape')}): "
+                f"{_fmt(mo.get('model_flops'), '.3e')} flops -> "
+                f"{_fmt(mo.get('achieved_gflops'))} GFLOP/s achieved")
+            lines.append(
+                f"- stage-traffic roofline: "
+                f"{_fmt(mo.get('model_bytes'), ',.0f')} B modeled -> "
+                f"{_fmt(mo.get('achieved_gbps'))} GB/s of "
+                f"{_fmt(mo.get('peak_gbps'))} GB/s peak "
+                f"({_fmt(mo.get('fraction_of_peak'), '.1%')} of "
+                "roofline)")
+        stg = ff.get("stages") or {}
+        rows = [(k, v) for k, v in stg.items() if v]
+        if rows:
+            lines += ["", "| scope | count | total ms | per-device ms |",
+                      "|---|---|---|---|"]
+            for name, row in rows:
+                lines.append(
+                    f"| `{name}` | {row.get('count')} "
+                    f"| {_fmt(row.get('total_ms'))} "
+                    f"| {_fmt(row.get('total_ms_per_device'))} |")
+            lines.append("")
+        if ff.get("transpose_exposed_ms") is not None:
+            lines.append(
+                f"- transposes: {_fmt(ff.get('transpose_hidden_ms'))} "
+                "ms hidden behind local FFT stages, "
+                f"{_fmt(ff.get('transpose_exposed_ms'))} ms exposed "
+                "(per-device)")
         lines.append("")
     lines += [
         "## Per-scope breakdown",

@@ -92,6 +92,20 @@ for _name in (
     # under Supervisor control — replayed spans after a recovery show
     # up as a second pass over the same step numbers in a trace
     "supervised_step",
+    # the sharded pencil-FFT tier (fourier.pencil): per-axis local FFT
+    # stages and the all_to_all transposes between them — the ledger's
+    # `fft` section derives its exposed-vs-hidden transpose split from
+    # these two rows, like the halo rows above
+    "fft_stage", "fft_transpose",
+    # the RAW XLA op rows of the same two phases — device traces (TPU
+    # and the TFRT CPU backend) carry `all-to-all.N` / `fft.N` op rows
+    # with no named-scope path; the ledger falls back to them when the
+    # scope-path rows are absent (longest-match folding keeps a
+    # TPU row like `jit(..)/fft_stage/fft.3` in `fft_stage`, not here)
+    "all-to-all", "fft",
+    # k-space stencil application through the transform
+    # (ops.fft_stencil)
+    "fft_stencil",
 ):
     register_scope(_name)
 del _name

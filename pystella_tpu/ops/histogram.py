@@ -32,7 +32,8 @@ import weakref
 from pystella_tpu import field as _field
 from pystella_tpu.ops.reduction import Reduction
 
-__all__ = ["Histogrammer", "FieldHistogrammer", "weighted_bincount"]
+__all__ = ["Histogrammer", "FieldHistogrammer", "weighted_bincount",
+           "bincount_core", "fetch_partials"]
 
 # cache keyed weakly on the decomp so discarded decompositions (and their
 # compiled executables) remain collectable
@@ -44,21 +45,39 @@ _bincount_cache = weakref.WeakKeyDictionary()
 _CHUNK = 1 << 22
 
 
-def _bincount_fn(decomp, outer_shape, num_bins, weighted,
-                 lattice_names=None):
-    """Build (and cache) the jitted distributed chunked bincount for a
-    given decomposition / outer shape / bin count. Returns per-device,
-    per-chunk partial histograms stacked along axis 0 (the host finalizes
-    in wide precision). ``lattice_names`` are the per-lattice-axis mesh
+def _flat_names(lattice_names):
+    """Per-axis layout entries flattened to the plain mesh-axis names
+    actually sharded over: an entry may be ``None``, one name, or a
+    TUPLE of names (the pencil-FFT k layout shards its y axis over the
+    combined ``(x, z, y)`` mesh axes)."""
+    out = []
+    for n in lattice_names:
+        if n is None:
+            continue
+        if isinstance(n, (tuple, list)):
+            out.extend(m for m in n if m is not None)
+        else:
+            out.append(n)
+    return tuple(out)
+
+
+def bincount_core(decomp, outer_shape, num_bins, weighted,
+                  lattice_names=None):
+    """The UNJITTED shard_map-wrapped local bincount (cached): callers
+    that fuse binning into a larger jitted program (the pencil-tier
+    spectra path) compose this; :func:`_bincount_fn` wraps it in its
+    own jit for standalone dispatch. Returns per-device, per-chunk
+    partial histograms stacked along axis 0 (the host finalizes in
+    wide precision). ``lattice_names`` are the per-lattice-axis mesh
     axis names of the input layout (default: the decomposition's
-    position-space layout; k-space callers keep the half-spectrum z axis
-    local and pass its names instead)."""
+    position-space layout; k-space callers pass their own — entries
+    may be combined-axis tuples)."""
     from jax.sharding import PartitionSpec as P
     if lattice_names is None:
         lattice_names = tuple(decomp.spec(0))
     lattice_names = tuple(lattice_names)
     per_decomp = _bincount_cache.setdefault(decomp, {})
-    key = (outer_shape, num_bins, weighted, lattice_names)
+    key = ("core", outer_shape, num_bins, weighted, lattice_names)
     cached = per_decomp.get(key)
     if cached is not None:
         return cached
@@ -69,7 +88,7 @@ def _bincount_fn(decomp, outer_shape, num_bins, weighted,
     # reduction, so no precision-losing f32/int32 cross-device sums;
     # stacking covers only the axes the input is actually sharded over
     # (mesh axes the input is replicated across would double count)
-    stack = tuple(n for n in lattice_names if n is not None)
+    stack = _flat_names(lattice_names)
     out_spec = P(stack or None, None)
 
     def flat_chunked_bins(b):
@@ -108,9 +127,36 @@ def _bincount_fn(decomp, outer_shape, num_bins, weighted,
                 lambda bi: jnp.bincount(bi, length=length + 1)[:length])(bb)
         in_specs = (spec,)
 
-    fn = jax.jit(decomp.shard_map(local, in_specs, out_spec))
+    fn = decomp.shard_map(local, in_specs, out_spec)
     per_decomp[key] = fn
     return fn
+
+
+def _bincount_fn(decomp, outer_shape, num_bins, weighted,
+                 lattice_names=None):
+    """Jitted wrapper of :func:`bincount_core` (cached)."""
+    per_decomp = _bincount_cache.setdefault(decomp, {})
+    key = ("jit", outer_shape, num_bins, weighted,
+           None if lattice_names is None else tuple(lattice_names))
+    cached = per_decomp.get(key)
+    if cached is None:
+        cached = jax.jit(bincount_core(decomp, outer_shape, num_bins,
+                                       weighted, lattice_names))
+        per_decomp[key] = cached
+    return cached
+
+
+def fetch_partials(partials):
+    """Per-device bincount partials as a host array: a plain device_get
+    on one controller; under multi-controller ``jax.distributed`` the
+    device axis spans non-addressable shards, so every process
+    allgathers the global value instead (the multihost analog of the
+    reference's host-side MPI allreduce, histogram.py:199-206)."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(partials, tiled=True)
+    return np.asarray(partials)
 
 
 def weighted_bincount(decomp, bins, weights, num_bins, lattice_names=None):
@@ -123,29 +169,16 @@ def weighted_bincount(decomp, bins, weights, num_bins, lattice_names=None):
     ``outer + (num_bins,)`` (float64, or int64 for counts). The shared
     primitive behind :class:`Histogrammer` and
     :class:`~pystella_tpu.PowerSpectra`."""
-    import jax
-
-    def fetch(partials):
-        """Per-device partials as a host array: a plain device_get on one
-        controller; under multi-controller ``jax.distributed`` the
-        device axis spans non-addressable shards, so every process
-        allgathers the global value instead (the multihost analog of
-        the reference's host-side MPI allreduce, histogram.py:199-206)."""
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            return multihost_utils.process_allgather(partials, tiled=True)
-        return np.asarray(partials)
-
     outer_shape = tuple(bins.shape[:-3])
     num_bins = int(num_bins)
     if weights is None:
         partials = _bincount_fn(decomp, outer_shape, num_bins, False,
                                 lattice_names)(bins)
-        h = fetch(partials).astype(np.int64).sum(axis=0)
+        h = fetch_partials(partials).astype(np.int64).sum(axis=0)
     else:
         partials = _bincount_fn(decomp, outer_shape, num_bins, True,
                                 lattice_names)(bins, weights)
-        h = fetch(partials).astype(np.float64).sum(axis=0)
+        h = fetch_partials(partials).astype(np.float64).sum(axis=0)
     return h.reshape(outer_shape + (num_bins,))
 
 

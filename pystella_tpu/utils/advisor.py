@@ -212,22 +212,34 @@ def advise_shapes(grid_shape, n_devices=1, halo_shape=2,
             else:
                 m.tiers["FD operators"] = "halo"
 
-        # DFT scheme selection (fourier/dft.py three tiers)
+        # FFT scheme selection: the shard_map pencil tier
+        # (fourier/pencil.py, make_dft's auto choice) when x/y divide
+        # the total device count, else the DFT fallback chain
+        # (fourier/dft.py partial/replicate)
         if ndev == 1:
             m.tiers["distributed FFT"] = "local"
         elif (grid_shape[0] % ndev == 0 and grid_shape[1] % ndev == 0):
-            m.tiers["distributed FFT"] = "pencil"
+            m.tiers["distributed FFT"] = "pencil-a2a"
         elif (pz == 1 and grid_shape[0] % px == 0
                 and grid_shape[1] % py == 0):
             m.tiers["distributed FFT"] = "partial"
+            m.notes.append(
+                "partial FFT tier only: grid x/y divisible by the "
+                f"TOTAL device count ({ndev}) would enable the fully "
+                "distributed pencil tier (no transient replication)")
         else:
             m.tiers["distributed FFT"] = "replicate!"
-            # complex spectrum itemsize: 2x the real dtype, min complex64
-            nbytes = int(np.prod(grid_shape)) * max(2 * itemsize, 8)
+            # complex HALF-spectrum itemsize (r2c): 2x the real dtype,
+            # min complex64, over (Nx, Ny, Nz//2+1)
+            kshape = (grid_shape[0], grid_shape[1],
+                      grid_shape[2] // 2 + 1)
+            nbytes = int(np.prod(kshape)) * max(2 * itemsize, 8)
             m.notes.append(
                 "no distributed FFT scheme: transforms would replicate "
                 f"~{nbytes / 2**30:.1f} GiB per device (raises above "
-                "the replicate limit)")
+                "the replicate limit) — prefer a grid whose x/y axes "
+                f"divide the device count ({ndev}), which takes the "
+                "pencil tier instead")
 
         # multigrid: depth while every LOCAL axis stays even and >= 4
         depth = 0
@@ -268,7 +280,7 @@ def advise_shapes(grid_shape, n_devices=1, halo_shape=2,
     def key(m):
         fused_rank = {"streaming": 0, "resident": 1}.get(
             m.tiers["fused stepper"], 2)
-        fft_rank = {"local": 0, "pencil": 0, "partial": 1}.get(
+        fft_rank = {"local": 0, "pencil-a2a": 0, "partial": 1}.get(
             m.tiers["distributed FFT"], 2)
         px, py, pz = m.proc_shape
         X, Y, Z = m.local_shape

@@ -21,7 +21,7 @@ def test_feasible_meshes_and_tiers():
     # z-sharded meshes rank below x/y-sharded ones
     assert best.proc_shape[2] == 1
     assert best.tiers["fused stepper"] == "streaming"
-    assert best.tiers["distributed FFT"] == "pencil"
+    assert best.tiers["distributed FFT"] == "pencil-a2a"
     zs = next(m for m in rep.meshes if m.proc_shape == (2, 2, 2))
     assert zs.tiers["fused stepper"].startswith("generic")
     assert "512" in rep.format() or "2x4x1" in rep.format()

@@ -123,11 +123,18 @@ def test_replicate_fallback_when_pencils_infeasible(make_decomp, caplog):
     assert np.allclose(np.asarray(fft.idft(fk)), fx, atol=1e-12)
 
     # production-size replicate is an OOM cliff: construction refuses
-    # (no arrays are allocated — the check is on the estimated size)
+    # (no arrays are allocated — the check is on the estimated size).
+    # The sized array is the r2c HALF spectrum (what the fallback
+    # actually replicates): 702*702*352 complex64 ~ 1.3 GiB > the
+    # 1 GiB default limit, while 514^3's half spectrum (~0.5 GiB,
+    # which the old full-grid accounting overstated 2x) now fits
     with pytest.raises(ValueError, match="replicate"):
-        ps.DFT(decomp, grid_shape=(514, 514, 514), dtype=np.float32)
+        ps.DFT(decomp, grid_shape=(702, 702, 702), dtype=np.float32)
+    fft_fit = ps.DFT(decomp, grid_shape=(514, 514, 514),
+                     dtype=np.float32)
+    assert fft_fit._scheme == "replicate"
     # ... unless explicitly accepted
-    fft_big = ps.DFT(decomp, grid_shape=(514, 514, 514),
+    fft_big = ps.DFT(decomp, grid_shape=(702, 702, 702),
                      dtype=np.float32, allow_replicate=True)
     assert fft_big._scheme == "replicate"
 

@@ -239,7 +239,8 @@ def test_cli_clean_repo():
     assert rep["ok"] is True
     assert set(rep["graph"]) == {"step_generic", "step_sentinel",
                                  "fused_multi_step",
-                                 "coupled_multi_step", "mg_smooth"}
+                                 "coupled_multi_step", "mg_smooth",
+                                 "ensemble_step", "sharded_spectra"}
     assert rep["summary"]["donation"]["coverage_pct"] == 100.0
 
 
