@@ -924,6 +924,18 @@ def run_smoke(argv=None):
                         "injected mid-run device-loss fault, completed "
                         "via restore-from-last-good — the report's "
                         "`resilience` section derives from it")
+    p.add_argument("--no-remesh", action="store_true",
+                   help="skip the re-mesh drill: a 16^3 run on the "
+                        "8-device (2,2,2) mesh under "
+                        "resilience.Supervisor with a PERSISTENT "
+                        "device-subset fault (half the mesh lost "
+                        "mid-run) and the RemeshPlanner as the default "
+                        "remesh policy — the run completes on the "
+                        "degraded 4-device mesh, the checkpoint is "
+                        "restored straight onto it, and the report's "
+                        "resilience `degraded` block (plus the gate's "
+                        "degraded-throughput audit) derives from the "
+                        "emitted remesh_plan record")
     p.add_argument("--no-spectra", action="store_true",
                    help="skip the sharded-spectra payload: a 16^3 "
                         "2-field power spectrum on the 8-device "
@@ -1227,6 +1239,88 @@ def run_smoke(argv=None):
             hb(f"smoke: supervised payload failed: "
                f"{type(e).__name__}: {e}")
             traceback.print_exc()
+
+    # re-mesh drill: a second supervised 16^3 run, this one sharded
+    # over the full 8-device (2,2,2) mesh, with a PERSISTENT
+    # device-subset fault taking half the mesh at step 9 of 12 and NO
+    # caller-provided remesh hook: the RemeshPlanner (the supervisor's
+    # default policy) solves the best feasible 4-device mesh, restores
+    # the durable step-8 checkpoint STRAIGHT onto it (the
+    # Checkpointer mesh= template path — never materialized on one
+    # device), rebuilds the step program through the same constructors,
+    # and the replay sails past the still-armed fault because the
+    # degraded program no longer touches the lost devices. The emitted
+    # remesh_plan record lands in the report's resilience `degraded`
+    # block, flips the throughput per-chip normalization to the
+    # SURVIVORS, and the gate's degraded-throughput audit accepts it —
+    # the smoke e2e (tests/test_gate.py) pins the whole chain. The
+    # final state is pinned bit-consistent with an uninterrupted run
+    # computed entirely on the degraded mesh's own trajectory.
+    if not args.no_remesh and len(jax.devices()) >= 8:
+        try:
+            import shutil
+            from pystella_tpu import resilience as rzl
+            rm_grid = (16, 16, 16)
+            rm_ck_dir = os.path.join(args.out, "remesh_ckpt")
+            shutil.rmtree(rm_ck_dir, ignore_errors=True)
+            rm_dec = ps.DomainDecomposition((2, 2, 2),
+                                            devices=jax.devices()[:8])
+            rm_args = {"a": np.float32(1.0), "hubble": np.float32(0.5)}
+
+            def rm_build_step(dec):
+                stp, _, rdt = build_preheat_step(
+                    rm_grid, fused=False, decomp=dec, make_state=False)
+                return lambda st, i: stp.step(st, np.float32(0.0), rdt,
+                                              rm_args)
+
+            rng = np.random.default_rng(7)
+            rm_host = {
+                "f": 1e-3 * rng.standard_normal(
+                    (2,) + rm_grid).astype(np.float32),
+                "dfdt": 1e-3 * rng.standard_normal(
+                    (2,) + rm_grid).astype(np.float32)}
+            rm_state = {k: rm_dec.shard(v) for k, v in rm_host.items()}
+            planner = rzl.RemeshPlanner(rm_dec, rm_grid, rm_build_step,
+                                        halo=2, label="smoke-remesh")
+            rm_mon = ps.HealthMonitor(every=2,
+                                      metrics_prefix="supervised")
+            with ps.Checkpointer(rm_ck_dir, max_to_keep=2) as rm_ck:
+                rm_sup = rzl.Supervisor(
+                    rm_build_step(rm_dec), rm_ck, 12, monitor=rm_mon,
+                    checkpoint_every=4, planner=planner,
+                    faults=rzl.FaultInjector.device_subset(
+                        step=9, count=4, label="smoke-remesh"),
+                    retry=rzl.RetryPolicy(base_s=0.05, max_s=0.2),
+                    label="smoke-remesh")
+                rm_rep = rm_sup.run(rm_state)
+            # reference: the degraded mesh's OWN uninterrupted
+            # trajectory — built on the very decomposition the planner
+            # realized (planner.decomp after the swap), so the pin
+            # compares against the mesh the run actually finished on
+            rm_ref_step = rm_build_step(planner.decomp)
+            rm_ref = {k: planner.decomp.shard(v)
+                      for k, v in rm_host.items()}
+            for i in range(12):
+                rm_ref = rm_ref_step(rm_ref, i)
+            sync(rm_ref)
+            rm_bit = all(
+                np.array_equal(np.asarray(rm_rep["state"][k]),
+                               np.asarray(rm_ref[k])) for k in rm_ref)
+            rm_plan = planner.last_plan
+            hb(f"smoke: remesh drill "
+               f"{'completed' if rm_rep['completed'] else 'FAILED'} "
+               f"{list(rm_plan.old_proc_shape) if rm_plan else '?'}"
+               f"->{list(rm_plan.new_proc_shape) if rm_plan else '?'} "
+               f"({len(rm_plan.devices) if rm_plan else '?'} "
+               f"survivor(s)), bit-consistent={rm_bit}")
+            if not (rm_rep["completed"] and rm_bit and rm_plan):
+                obs.emit("smoke_remesh_failed",
+                         completed=rm_rep["completed"], bitexact=rm_bit)
+        except Exception as e:  # noqa: BLE001 — record, never kill smoke
+            hb(f"smoke: remesh drill failed: {type(e).__name__}: {e}")
+            traceback.print_exc()
+    elif not args.no_remesh:
+        hb("smoke: <8 devices — skipping the remesh drill")
 
     # AOT warm-start leg: export the very step program this run timed,
     # reload the artifact, and pin the loaded program bit-exact against

@@ -30,7 +30,16 @@ costs one leg, not the window):
    replay, and a bit-consistency pin against an uninterrupted run —
    recording the on-hardware MTTR and the checkpoint durability-
    barrier overhead that CPU rehearsal cannot measure.
-6. ``spectral``     — PR 10: the sharded-spectra leg. Power spectra of
+6. ``remesh``       — PR 11: the degraded-continuation leg. A
+   supervised 512³ run sharded over the WHOLE held mesh with a
+   PERSISTENT device-subset fault killing one chip's worth of devices
+   mid-run and the ``RemeshPlanner`` as the default policy (no remesh
+   hook): the run solves a degraded mesh over the survivors, restores
+   the durable checkpoint straight onto it, and finishes — recording
+   the remesh MTTR (solve + reshard + rebuild + recompile, which CPU
+   rehearsal cannot price) and the degraded site-updates/s per
+   SURVIVING chip against the full-mesh figure from the same leg.
+7. ``spectral``     — PR 10: the sharded-spectra leg. Power spectra of
    a 2-field 256³ (then 512³, budget permitting) lattice through the
    fully distributed pencil-FFT tier (``fourier.pencil``: explicit
    all_to_all transposes inside shard_map, one fused dispatch) on the
@@ -39,7 +48,7 @@ costs one leg, not the window):
    session) — the number the spectral tier exists to beat — plus the
    ``fft`` ledger section's per-stage/transpose split from a profiler
    capture of the calls.
-7. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
+8. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
    wires a FRESH ``PYSTELLA_COMPILE_CACHE_DIR``, builds the 512³
    multigrid + preheat step programs cold (recording
    time-to-first-step and the trace/compile split), and AOT-exports
@@ -269,6 +278,107 @@ def worker_elastic(dry_run):
                  and bit_ok) else 1
 
 
+def worker_remesh(dry_run):
+    """Degraded continuation on the held mesh: a supervised run
+    sharded over ALL devices, a persistent device-subset fault killing
+    one chip's worth of them mid-run, the RemeshPlanner as the default
+    policy — measure the remesh MTTR (solve + reshard + rebuild +
+    recompile on hardware) and the degraded throughput per SURVIVING
+    chip, with pre-loss step timings from the same run as the
+    full-mesh reference."""
+    backend, ndev, dial_s = _dial(dry_run)
+    import numpy as np
+    sys.path.insert(0, REPO)
+    import bench
+    import pystella_tpu as ps
+    from pystella_tpu import obs, resilience
+    from pystella_tpu.obs.ledger import PerfLedger, step_stats
+
+    events_path = os.path.join(OUT, "tpu_window_events.jsonl")
+    obs.configure(events_path)
+    obs.ensure_compilation_cache(
+        os.path.join(OUT, "tpu_window_xla_cache"))
+    if ndev < 2:
+        record("remesh", backend=backend, ndevices=ndev,
+               skipped="needs >= 2 devices to lose one chip's worth")
+        return 0
+    n = 16 if dry_run else 512
+    nsteps = 12 if dry_run else 48
+    every = 4 if dry_run else 16
+    fault_step = nsteps - every + 1
+    lose = max(1, ndev // 2) if dry_run else max(1, ndev // 4)
+
+    grid = (n, n, n)
+    decomp = ps.DomainDecomposition((ndev, 1, 1))
+    rhs_args = {"a": np.float32(1.0), "hubble": np.float32(0.5)}
+    step_times = []
+
+    def build_step(dec):
+        stepper, _, dt = bench.build_preheat_step(
+            grid, fused=False, decomp=dec, make_state=False)
+
+        def step_fn(st, i):
+            t0 = time.perf_counter()
+            out = stepper.step(st, np.float32(0.0), dt, rhs_args)
+            bench.sync(out)
+            ms = (time.perf_counter() - t0) * 1e3
+            step_times.append(ms)
+            obs.emit("step_time", ms=ms, label="window-remesh")
+            return out
+        return step_fn
+
+    rng = np.random.default_rng(5)
+    state = {
+        "f": decomp.shard(
+            1e-3 * rng.standard_normal((2,) + grid).astype(np.float32)),
+        "dfdt": decomp.shard(
+            1e-3 * rng.standard_normal((2,) + grid).astype(np.float32))}
+
+    ck_dir = os.path.join(OUT, "tpu_window_remesh_ckpt")
+    import shutil
+    shutil.rmtree(ck_dir, ignore_errors=True)
+    planner = resilience.RemeshPlanner(decomp, grid, build_step,
+                                       halo=2, label="window-remesh")
+    mon = ps.HealthMonitor(every=4, metrics_prefix="supervised")
+    t0 = time.perf_counter()
+    with ps.Checkpointer(ck_dir, max_to_keep=2) as ck:
+        sup = resilience.Supervisor(
+            build_step(decomp), ck, nsteps, monitor=mon,
+            checkpoint_every=every, planner=planner,
+            faults=resilience.FaultInjector.device_subset(
+                step=fault_step, count=lose, label="window-remesh"),
+            label="window-remesh")
+        rep = sup.run(state)
+    wall_s = time.perf_counter() - t0
+    inc = rep["incident_records"][0] if rep["incident_records"] else {}
+    plan = planner.last_plan
+    survivors = len(plan.devices) if plan else None
+    led = PerfLedger.from_events(events_path, label="window-remesh",
+                                 sites=2 * n**3)
+    rz = led.resilience() or {}
+    deg = (rz.get("degraded") or {}) if isinstance(
+        rz.get("degraded"), dict) else {}
+    pre = step_stats(step_times[:fault_step]) if step_times else {}
+    record("remesh", backend=backend, ndevices=ndev, grid=n,
+           nsteps=nsteps, checkpoint_every=every, lost=lose,
+           dial_s=round(dial_s, 2), wall_s=round(wall_s, 2),
+           completed=rep["completed"], incidents=rep["incidents"],
+           remesh_mttr_s=inc.get("mttr_s"),
+           old_mesh=list(plan.old_proc_shape) if plan else None,
+           new_mesh=(list(plan.new_proc_shape)
+                     if plan and plan.feasible else None),
+           survivors=survivors,
+           full_mesh_p50_ms=pre.get("p50_ms"),
+           full_mesh_site_updates_per_s_per_chip=(
+               2 * n**3 * 1e3 / pre["p50_ms"] / ndev
+               if pre.get("p50_ms") else None),
+           degraded_site_updates_per_s_per_surviving_chip=(
+               (deg.get("post_remesh") or {}).get(
+                   "site_updates_per_s_per_surviving_chip")))
+    return 0 if (rep["completed"] and rep["incidents"] == 1
+                 and plan is not None and plan.feasible) else 1
+
+
 #: the cached-hardware gw-spectra-256^3 figure the spectral leg holds
 #: itself against (BENCH_r04: single-chip replicate/local transform)
 SPECTRA_BASELINE_MS = 241.0
@@ -446,8 +556,8 @@ def worker_cold_start(dry_run, phase):
 def main():
     p = argparse.ArgumentParser(prog="tpu_window_validation.py")
     p.add_argument("--legs", default="perf_trace,overlap,lint_tpu,"
-                                     "ensemble,elastic,spectral,"
-                                     "cold_start",
+                                     "ensemble,elastic,remesh,"
+                                     "spectral,cold_start",
                    help="comma-separated legs, priority order")
     p.add_argument("--dry-run", action="store_true",
                    help="CPU + tiny grids: rehearse the plumbing")
@@ -463,6 +573,7 @@ def main():
               "lint_tpu": worker_lint_tpu,
               "ensemble": worker_ensemble,
               "elastic": worker_elastic,
+              "remesh": worker_remesh,
               "spectral": worker_spectral}.get(args.worker)
         if fn is not None:
             return fn(args.dry_run)

@@ -49,7 +49,8 @@ from pystella_tpu.ensemble import (
     EnsembleDriver, EnsembleMonitor, EnsembleStepper, Scenario)
 from pystella_tpu import resilience
 from pystella_tpu.resilience import (
-    FaultInjector, RecoveryFailed, RetryPolicy, Supervisor)
+    DeviceSubsetFault, FaultInjector, RecoveryFailed, RemeshPlanner,
+    RetryPolicy, Supervisor)
 from pystella_tpu.utils import (Checkpointer, HealthMonitor,
     SimulationDiverged, OutputFile, ShardedSnapshot, StepTimer, timer,
     trace, advise_shapes)
@@ -99,7 +100,7 @@ __all__ = [
     "ensemble", "EnsembleStepper", "EnsembleDriver", "Scenario",
     "EnsembleMonitor",
     "resilience", "Supervisor", "FaultInjector", "RetryPolicy",
-    "RecoveryFailed",
+    "RecoveryFailed", "RemeshPlanner", "DeviceSubsetFault",
     "ElementWiseMap",
     "FirstCenteredDifference", "SecondCenteredDifference",
     "FiniteDifferencer",

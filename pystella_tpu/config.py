@@ -195,6 +195,18 @@ register("PYSTELLA_RESILIENCE_RETRY_BUDGET_S", default="600",
          help="wall budget in seconds for ONE incident's recovery "
               "attempts (re-dial + restore retries); exhausting it "
               "raises RecoveryFailed with the last underlying error")
+register("PYSTELLA_FAULT_DEVICE_SUBSET", default=None,
+         help="arm a DeviceSubsetFault from the environment "
+              "(resilience.FaultInjector.from_env, consumed by drivers "
+              "that opt in, e.g. the remesh drills): '<step>:<count>' "
+              "loses the last <count> devices of the state's device "
+              "set entering <step>; unset disables")
+register("PYSTELLA_FAULT_DEVICE_SUBSET_PERSIST", default="1", kind="bool",
+         help="persistence of the env-armed device-subset fault: 1 "
+              "(default) models real hardware — lost devices STAY "
+              "lost, and only a re-meshed program that no longer "
+              "touches them replays through cleanly; 0 makes it a "
+              "one-shot transient like the other fault kinds")
 register("PYSTELLA_FFT_SCHEME", default="auto",
          help="distributed-FFT scheme the planner (fourier.plan."
               "make_dft) and the spectra/projector/Poisson consumers "

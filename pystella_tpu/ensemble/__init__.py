@@ -32,9 +32,9 @@ donation/collective/dtype audits cover the batched program too. See
 ``doc/ensemble.md``.
 """
 
-from pystella_tpu.ensemble.batch import EnsembleStepper
+from pystella_tpu.ensemble.batch import EnsembleStepper, repack_members
 from pystella_tpu.ensemble.driver import EnsembleDriver, Scenario
 from pystella_tpu.ensemble.health import EnsembleMonitor, Eviction
 
 __all__ = ["EnsembleStepper", "EnsembleDriver", "Scenario",
-           "EnsembleMonitor", "Eviction"]
+           "EnsembleMonitor", "Eviction", "repack_members"]

@@ -48,7 +48,24 @@ import jax.numpy as jnp
 from pystella_tpu.obs import memory as _obs_memory
 from pystella_tpu.obs.scope import trace_scope
 
-__all__ = ["EnsembleStepper"]
+__all__ = ["EnsembleStepper", "repack_members"]
+
+
+def repack_members(batch, decomp):
+    """Re-place a batched ``(members, ...)`` state pytree onto a
+    DIFFERENT ensemble decomposition — the member-axis repack of a
+    re-mesh (:mod:`pystella_tpu.resilience.remesh`): the member count
+    is unchanged, but the ensemble device extent shrank, so ``E``
+    members over ``D'`` surviving devices land as ``E / D'`` per mesh
+    slice. The new extent must divide the member count
+    (``shard_members`` raises otherwise — the planner's member-axis
+    shrink rule guarantees it picks such an extent). Checkpointed
+    batches take the equivalent zero-copy path through
+    ``Checkpointer.restore(mesh=new_decomp)`` instead; this is the
+    in-memory repack for a batch that survived in host or device
+    buffers."""
+    import jax as _jax
+    return _jax.tree_util.tree_map(decomp.shard_members, batch)
 
 
 class EnsembleStepper:

@@ -274,7 +274,15 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     (``resilience.consistent`` false) is hiding a degraded fleet
     behind a clean headline — invalid evidence, exit 2. Lost
     resilience coverage warns, and unresolved incidents (detected but
-    never resumed) warn too.
+    never resumed) warn too. Degraded-MODE accounting (the re-mesh
+    library, :mod:`pystella_tpu.resilience.remesh`): a report whose
+    ``resilience.degraded`` block records a re-mesh but whose
+    ``throughput.per_chip`` still normalizes by the full pre-loss
+    mesh is claiming full-mesh throughput from a degraded run —
+    invalid evidence, exit 2 (the honest figure divides by the
+    survivors; the ledger produces it automatically from the
+    ``remesh_plan`` record) — and a run that finished degraded
+    without any ``remesh_plan`` record warns (unauditable).
     """
     verdict = {"ok": True, "exit_code": 0, "reasons": [],
                "warnings": []}
@@ -291,6 +299,44 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
             "over a degraded fleet proves nothing; trust the event "
             "log, not the claim")
         return verdict
+    # degraded-mode accounting (the re-mesh library,
+    # resilience.remesh): a run that finished on a DEGRADED mesh must
+    # say so auditable. A recorded remesh whose throughput section
+    # still normalizes per pre-loss chip is claiming full-mesh
+    # throughput from a degraded run — invalid evidence; a run that
+    # degraded (run_degraded) without any remesh_plan record cannot be
+    # audited at all and warns.
+    deg = cres.get("degraded")
+    if check_resilience and isinstance(deg, dict):
+        if deg.get("new_mesh"):
+            used = deg.get("devices_used")
+            rate = (current.get("throughput") or {}).get(
+                "site_updates_per_s")
+            pc = (current.get("throughput") or {}).get("per_chip")
+            if used and rate and (not pc
+                                  or pc.get("basis") != "surviving"
+                                  or pc.get("chips") != used):
+                verdict.update(ok=False, exit_code=2)
+                verdict["reasons"].append(
+                    "invalid_evidence: run re-meshed to "
+                    f"{deg.get('new_mesh')} ({used} surviving "
+                    "device(s)) but its throughput claims a "
+                    "full-mesh per-chip normalization — a degraded "
+                    "run's per-chip figure divides by the SURVIVORS")
+                return verdict
+        elif deg.get("events") and not deg.get("remesh_plans"):
+            verdict["warnings"].append(
+                "resilience: the run finished degraded (run_degraded "
+                "recorded) without a matching remesh_plan record — "
+                "the degraded mesh cannot be audited; use the "
+                "RemeshPlanner (or emit remesh_plan from the hook)")
+    elif check_resilience and deg:
+        # pre-remesh-library reports: a bare run_degraded event list
+        verdict["warnings"].append(
+            "resilience: the run finished degraded (run_degraded "
+            "recorded) without a matching remesh_plan record — "
+            "the degraded mesh cannot be audited; use the "
+            "RemeshPlanner (or emit remesh_plan from the hook)")
     # ANY recorded incident marks the evidence degraded (annotated) —
     # but only REAL (non-injected) incidents soften the verdicts
     # below. A harness DRILL (faults_injected covers the incident

@@ -251,6 +251,8 @@ class PerfLedger:
         self.durable_steps = []         # checkpoint_durable steps, in order
         self.checkpoint_barrier_s = 0.0  # summed durability-barrier waits
         self.supervisor_runs = []       # supervisor_done payloads, in order
+        self.remesh_plans = []          # remesh_plan payloads, in order
+        self._post_remesh_start = None  # samples_ms index at last remesh
         self.fft_runs = []              # fft_spectra payloads (driver legs)
         self.spectra_ms = []            # per-call spectra wall times
         #                                 (spectra_time events — drivers
@@ -372,6 +374,18 @@ class PerfLedger:
             elif kind == "run_degraded":
                 led.degraded_events.append(
                     {"step": ev.get("step"), **data})
+            elif kind == "remesh_plan":
+                # the re-mesh library's decision record (resilience.
+                # remesh): old/new mesh, survivors, rejected
+                # candidates. Only a plan that actually CHANGED the
+                # mesh marks degradation (a transport-blip recovery
+                # emits changed=False and leaves the program alone);
+                # steps ingested after a changed plan are the degraded
+                # mesh's — the `degraded` block normalizes throughput
+                # per SURVIVING chip from them.
+                led.remesh_plans.append({"step": ev.get("step"), **data})
+                if data.get("changed") and data.get("feasible"):
+                    led._post_remesh_start = len(led.samples_ms)
             elif kind == "run_preempted":
                 led.preempted_events.append(
                     {"step": ev.get("step"), **data})
@@ -401,6 +415,11 @@ class PerfLedger:
                 led.meta = data
         if not led.samples_ms and window_ms:
             led.samples_ms = window_ms
+            # window averages cannot be attributed before/after a
+            # remesh (the index marker was taken against the empty
+            # per-step list): drop the post-remesh split rather than
+            # blending full-mesh windows into the degraded stats
+            led._post_remesh_start = None
         if led.sites is None:
             shape = led.meta.get("grid_shape")
             if isinstance(shape, (list, tuple)) and shape:
@@ -725,7 +744,8 @@ class PerfLedger:
         # record then rides inside it.
         if not (self.faults_detected or self.faults_injected
                 or self.resumes or self.recovery_failures
-                or self.preempted_events or self.supervisor_runs):
+                or self.preempted_events or self.supervisor_runs
+                or self.remesh_plans):
             return None
         incidents = [
             {"kind": r.get("fault_kind"),
@@ -788,7 +808,7 @@ class PerfLedger:
             "recovery_failures": self.recovery_failures[:8],
             "faults_injected": self.faults_injected,
             "preempted": bool(self.preempted_events),
-            "degraded": self.degraded_events[:8],
+            "degraded": self.degraded_block(),
             "checkpoints": {
                 "saved": self.checkpoint_counts.get(
                     "checkpoint_save", 0),
@@ -803,6 +823,56 @@ class PerfLedger:
                 "barrier_pct_of_wall": overhead_pct,
             },
         }
+
+    def degraded_block(self):
+        """The degraded-mode accounting inside the ``resilience``
+        section (``None`` when the run never degraded): the
+        ``run_degraded`` notes, the ``remesh_plan`` decision records
+        (:mod:`pystella_tpu.resilience.remesh` — old -> new mesh,
+        survivors, rejected candidates), and the post-remesh
+        throughput normalized per **surviving** chip — the only honest
+        per-chip figure for a window that finished on fewer devices
+        than it started with. The gate refuses a degraded report whose
+        throughput section still normalizes by the full pre-loss mesh
+        (:func:`pystella_tpu.obs.gate.compare_reports`)."""
+        plan = self._degrading_plan()
+        if not (self.degraded_events or plan is not None):
+            # blip-only remesh_plan records (changed=False: every old
+            # device survived, nothing was swapped) do NOT make a
+            # degraded block — the window never degraded
+            return None
+        block = {"events": self.degraded_events[:8],
+                 "remesh_plans": self.remesh_plans[:4]}
+        if plan is not None:
+            used = plan.get("devices") or plan.get("survivors") or []
+            block.update({
+                "old_mesh": plan.get("old_proc_shape"),
+                "new_mesh": plan.get("new_proc_shape"),
+                "surviving_devices": (len(plan.get("survivors"))
+                                      if isinstance(plan.get("survivors"),
+                                                    list) else None),
+                "devices_used": len(used) if isinstance(used, list)
+                else None,
+                "lost_devices": (len(plan.get("lost"))
+                                 if isinstance(plan.get("lost"), list)
+                                 else None),
+            })
+            post = (self.samples_ms[self._post_remesh_start:]
+                    if self._post_remesh_start is not None else [])
+            post_block = None
+            if post:
+                stats = step_stats(post)
+                per_chip = None
+                if self.sites and stats.get("p50_ms") and used:
+                    per_chip = (float(self.sites) * 1e3
+                                / stats["p50_ms"] / len(used))
+                post_block = {
+                    "samples": len(post),
+                    "p50_ms": stats.get("p50_ms"),
+                    "site_updates_per_s_per_surviving_chip": per_chip,
+                }
+            block["post_remesh"] = post_block
+        return block
 
     def fft(self):
         """The distributed-spectral-tier summary
@@ -907,6 +977,49 @@ class PerfLedger:
             "num_devices": ndev,
         }
 
+    def _degrading_plan(self):
+        """The last remesh_plan that actually changed the mesh
+        (``changed`` and ``feasible``), or ``None`` — transport-blip
+        recoveries emit ``changed=False`` plans that must not make a
+        window read as degraded."""
+        for plan in reversed(self.remesh_plans):
+            if plan.get("changed") and plan.get("feasible"):
+                return plan
+        return None
+
+    def _per_chip_throughput(self):
+        """The per-chip normalization of the headline throughput —
+        and the honesty marker the gate audits: a window that
+        re-meshed finished on the SURVIVORS, so its per-chip figure
+        uses the POST-remesh step times divided by the degraded
+        mesh's device count (``basis: "surviving"``) — never the
+        full-mesh-dominated whole-window median over the survivors,
+        which would overstate the degraded throughput ~(lost/survived)
+        fold. ``None`` rate when no post-remesh samples exist (e.g. a
+        drill whose timed loop ran before the remesh); ``None``
+        entirely when no device count is known."""
+        plan = self._degrading_plan()
+        if plan is not None:
+            used = plan.get("devices") or plan.get("survivors") or []
+            chips = len(used) if isinstance(used, list) else None
+            post = (self.samples_ms[self._post_remesh_start:]
+                    if self._post_remesh_start is not None else [])
+            rate = None
+            if post and self.sites:
+                p50 = step_stats(post).get("p50_ms")
+                if p50:
+                    rate = float(self.sites) * 1e3 / p50
+            basis = "surviving"
+        else:
+            rate = self.site_updates_per_s()
+            chips = self.env.get("num_devices")
+            basis = "all"
+        if not chips:
+            return None
+        return {"chips": int(chips), "basis": basis,
+                "site_updates_per_s_per_chip": (rate / chips
+                                                if rate else None)}
+
     # -- report ------------------------------------------------------------
 
     def report(self):
@@ -924,6 +1037,7 @@ class PerfLedger:
             "throughput": {
                 "sites": self.sites,
                 "site_updates_per_s": self.site_updates_per_s(),
+                "per_chip": self._per_chip_throughput(),
             },
             "roofline": self.roofline(),
             "overlap": self.overlap_summary(),
@@ -1226,9 +1340,31 @@ def render_markdown(rep):
         if rz.get("preempted"):
             lines.append("- run **preempted** (drained to a durable "
                          "checkpoint; resume with the supervisor)")
-        for d in (rz.get("degraded") or [])[:4]:
-            lines.append(f"- **degraded** at step {d.get('step')}: "
-                         f"{d.get('note')}")
+        deg = rz.get("degraded")
+        if isinstance(deg, dict):
+            for d in (deg.get("events") or [])[:4]:
+                lines.append(f"- **degraded** at step {d.get('step')}: "
+                             f"{d.get('note')}")
+            if deg.get("new_mesh"):
+                total = ((deg.get("devices_used") or 0)
+                         + (deg.get("lost_devices") or 0))
+                lines.append(
+                    f"- re-mesh: {deg.get('old_mesh')} -> "
+                    f"{deg.get('new_mesh')} "
+                    f"({_fmt(deg.get('devices_used'), '.0f')} of "
+                    f"{_fmt(total, '.0f')} devices)")
+            post = deg.get("post_remesh")
+            if post:
+                lines.append(
+                    "- post-remesh: p50 "
+                    f"{_fmt(post.get('p50_ms'))} ms/step over "
+                    f"{post.get('samples')} sample(s), "
+                    f"{_fmt(post.get('site_updates_per_s_per_surviving_chip'), '.3e')}"
+                    " site-updates/s per SURVIVING chip")
+        elif deg:  # pre-remesh-library reports: a bare event list
+            for d in deg[:4]:
+                lines.append(f"- **degraded** at step {d.get('step')}: "
+                             f"{d.get('note')}")
         lines.append("")
     ff = rep.get("fft")
     if ff:

@@ -669,6 +669,25 @@ class DomainDecomposition:
                                  in_specs=in_specs, out_specs=out_specs,
                                  **kwargs)
 
+    # -- decomposition from a device set (the re-mesh path) -----------------
+
+    def with_devices(self, devices, proc_shape=None):
+        """A new decomposition with the SAME halo widths and axis
+        names over a different device set — the
+        decomposition-from-device-set constructor the re-mesh library
+        (:mod:`pystella_tpu.resilience.remesh`) builds degraded
+        continuations from. ``proc_shape`` defaults to all devices
+        along the leading axis; an ensemble decomposition cannot be
+        rebuilt this way (its mesh carries the member axis — use
+        :func:`ensemble_mesh` and the planner's ensemble path)."""
+        if self.ensemble_axis is not None:
+            raise ValueError(
+                "with_devices rebuilds spatial decompositions only; "
+                "build an ensemble_mesh for the member-axis path")
+        return DomainDecomposition(
+            proc_shape, halo_shape=self.halo_shape,
+            axis_names=self.axis_names, devices=list(devices))
+
     # -- bookkeeping matching reference get_rank_shape_start ----------------
 
     def rank_shape(self, grid_shape):

@@ -20,7 +20,7 @@ from __future__ import annotations
 import jax
 
 __all__ = ["init_multihost", "is_initialized", "shutdown", "reinit",
-           "global_devices", "host_local_to_global",
+           "global_devices", "live_devices", "host_local_to_global",
            "global_to_host_local", "sync_hosts", "all_gather_hosts"]
 
 _initialized = False
@@ -96,6 +96,21 @@ def global_devices():
     """All devices across all hosts (the mesh should be built from these —
     ``DomainDecomposition(proc_shape, devices=global_devices())``)."""
     return jax.devices()
+
+
+def live_devices():
+    """The devices visible RIGHT NOW — the survivor probe a re-mesh
+    runs after :func:`reinit`: a re-dialed smaller cluster simply
+    reports fewer devices, and the
+    :class:`~pystella_tpu.resilience.remesh.RemeshPlanner` intersects
+    this with the failed mesh's device set. Degrades to this process's
+    local devices when the global query itself fails (the coordinator
+    died with the lost host) — the survivors a single process can
+    still vouch for."""
+    try:
+        return list(jax.devices())
+    except Exception:
+        return list(jax.local_devices())
 
 
 def host_local_to_global(decomp, host_arrays, outer_axes=0):

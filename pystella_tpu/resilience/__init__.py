@@ -22,21 +22,35 @@ catastrophe:
   (``fault_detected``/``recovery_attempt``/``run_resumed``/
   ``run_degraded``) the ledger's ``resilience`` report section and the
   gate's degraded-annotation verdicts are built from.
+- :mod:`pystella_tpu.resilience.remesh` — :class:`RemeshPlanner`, the
+  supervisor's DEFAULT remesh policy: solve the best feasible degraded
+  mesh over the surviving devices (halo/grid/pencil-FFT feasibility,
+  ensemble member-axis shrink), reshard the last durable checkpoint
+  straight onto it (``Checkpointer.restore(mesh=...)`` — never
+  materialized on one device), rebuild the step function through the
+  original constructors, and emit the auditable ``remesh_plan``
+  record. Device loss becomes a measured, gated degradation instead of
+  an abort.
 
 See ``doc/resilience.md`` for the supervisor contract, the fault
-taxonomy, and replay semantics.
+taxonomy, replay semantics, and degraded-mesh continuation.
 """
 
 from pystella_tpu.resilience.retry import (
     Retrier, RetryPolicy, classify_exception, retry_call)
 from pystella_tpu.resilience.faults import (
-    Fault, FaultInjector, NaNFault, RaiseFault, SigtermFault,
-    device_loss_error)
+    DeviceSubsetFault, Fault, FaultInjector, NaNFault, RaiseFault,
+    SigtermFault, device_loss_error)
+from pystella_tpu.resilience.remesh import (
+    RemeshPlan, RemeshPlanner, feasible_proc_shapes,
+    proc_shape_candidates)
 from pystella_tpu.resilience.supervisor import RecoveryFailed, Supervisor
 
 __all__ = [
     "Retrier", "RetryPolicy", "classify_exception", "retry_call",
-    "Fault", "FaultInjector", "NaNFault", "RaiseFault", "SigtermFault",
-    "device_loss_error",
+    "DeviceSubsetFault", "Fault", "FaultInjector", "NaNFault",
+    "RaiseFault", "SigtermFault", "device_loss_error",
+    "RemeshPlan", "RemeshPlanner", "feasible_proc_shapes",
+    "proc_shape_candidates",
     "RecoveryFailed", "Supervisor",
 ]

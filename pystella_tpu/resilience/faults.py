@@ -27,12 +27,24 @@ Fault taxonomy (``doc/resilience.md``):
 - :class:`SigtermFault` — send this process SIGTERM entering step N:
   the preemption notice a managed TPU VM gets. The supervisor's
   handler drains, checkpoints durably, and exits clean.
+- :class:`DeviceSubsetFault` — targeted loss of a named device subset
+  (explicit ids, the last ``count`` devices, or a mesh-axis slice):
+  the re-mesh drill's fault. **Persistent by default**
+  (``once=False``) because lost hardware stays lost — but after a
+  firing it only re-raises while the state still TOUCHES a lost
+  device, so a correct re-mesh (the program rebuilt over the
+  survivors) sails through the replay while a broken one re-trips
+  into the deterministic-recurrence give-up path. The fired devices
+  land in :meth:`FaultInjector.lost_devices`, which the
+  :class:`~pystella_tpu.resilience.remesh.RemeshPlanner` consults as
+  its survivor probe in deterministic single-process drills.
 
-Every fault is **one-shot by default** (``once=True``): after a
-recovery rolls the run back past the fault step, replaying through it
-must not re-fire — that is exactly the transient-fault contract. Pass
-``once=False`` to model a persistent (deterministic) fault and test
-the give-up path instead.
+Every raising/corrupting fault is **one-shot by default**
+(``once=True``): after a recovery rolls the run back past the fault
+step, replaying through it must not re-fire — that is exactly the
+transient-fault contract. Pass ``once=False`` to model a persistent
+(deterministic) fault and test the give-up path instead
+(:class:`DeviceSubsetFault` inverts the default, as above).
 
 Each firing emits a ``fault_injected`` run event, so a supervised run's
 event log records what the harness did to it alongside what the
@@ -49,7 +61,7 @@ import numpy as np
 from pystella_tpu.obs import events as _events
 
 __all__ = ["Fault", "RaiseFault", "NaNFault", "SigtermFault",
-           "FaultInjector", "device_loss_error"]
+           "DeviceSubsetFault", "FaultInjector", "device_loss_error"]
 
 
 def device_loss_error(detail="injected device loss (fault harness)"):
@@ -168,6 +180,104 @@ class NaNFault(Fault):
                 "index": self.index}
 
 
+def state_devices(state):
+    """Every device the leaves of ``state`` are committed to, sorted
+    by id — the "what does the program still touch" probe behind
+    :class:`DeviceSubsetFault`'s persistence semantics."""
+    import jax
+    devs = set()
+    for leaf in jax.tree_util.tree_leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                devs.update(sharding.device_set)
+            except Exception:
+                pass
+    return sorted(devs, key=lambda d: getattr(d, "id", 0))
+
+
+class DeviceSubsetFault(Fault):
+    """Lose a named subset of the devices the state lives on.
+
+    :arg step: first step the loss is visible at (it persists after).
+    :arg device_ids: explicit device ids to lose, or
+    :arg count: lose the LAST ``count`` devices of the state's sorted
+        device set (the common "one host's chips died" drill), or
+    :arg mesh: + :arg axis: + :arg index: lose mesh-axis slice
+        ``index`` of ``axis`` of ``mesh`` (a named topology slice —
+        e.g. ``axis="x", index=1`` on a (2,2,2) mesh loses 4 devices).
+    :arg once: default **False** — lost hardware stays lost. After the
+        first firing the fault re-raises only while the state still
+        touches a lost device, so a remeshed program replays through
+        cleanly and the lost set stays queryable via
+        :meth:`FaultInjector.lost_devices`.
+
+    Env spec (``PYSTELLA_FAULT_DEVICE_SUBSET``, parsed by
+    :meth:`from_spec`): ``"<step>:<count>"`` — e.g. ``"9:4"`` loses
+    the last 4 devices entering step 9.
+    """
+
+    kind = "device_subset"
+
+    def __init__(self, step, device_ids=None, count=None, mesh=None,
+                 axis=None, index=None, once=False):
+        super().__init__(step, once=once)
+        if device_ids is None and count is None and axis is None:
+            raise ValueError("DeviceSubsetFault needs device_ids=, "
+                             "count=, or mesh=/axis=/index=")
+        self.device_ids = (None if device_ids is None
+                           else sorted(int(i) for i in device_ids))
+        self.count = None if count is None else int(count)
+        if axis is not None:
+            if mesh is None or index is None:
+                raise ValueError("axis= needs mesh= and index=")
+            sliced = np.take(mesh.devices,
+                             int(index), axis=mesh.axis_names.index(axis))
+            self.device_ids = sorted(
+                int(d.id) for d in np.asarray(sliced).flat)
+        #: the concrete lost devices, resolved at first firing
+        self.lost = []
+
+    @classmethod
+    def from_spec(cls, spec, **kwargs):
+        """Parse the env-knob spelling ``"<step>:<count>"``."""
+        step, _, count = str(spec).partition(":")
+        return cls(int(step), count=int(count or 1), **kwargs)
+
+    def should_fire(self, step):
+        if self.once and self.fired:
+            return False
+        # persistent: armed from its step ON — lost hardware stays lost
+        return int(step) >= self.step
+
+    def still_applies(self, state):
+        """After the first firing, only a program still touching a
+        lost device faults again — the probe that makes a correct
+        re-mesh provable by the replay NOT re-raising."""
+        if not self.fired:
+            return True
+        lost = set(self.lost)
+        return any(d in lost for d in state_devices(state))
+
+    def _fire(self, state):
+        if not self.lost:
+            devs = state_devices(state)
+            if self.device_ids is not None:
+                ids = set(self.device_ids)
+                self.lost = [d for d in devs
+                             if int(getattr(d, "id", -1)) in ids]
+            else:
+                self.lost = devs[len(devs) - min(self.count, len(devs)):]
+        ids = [int(getattr(d, "id", -1)) for d in self.lost]
+        raise device_loss_error(
+            f"device(s) {ids} lost (device-subset fault)")
+
+    def describe(self):
+        return {**super().describe(),
+                "device_ids": self.device_ids, "count": self.count,
+                "lost": [int(getattr(d, "id", -1)) for d in self.lost]}
+
+
 class SigtermFault(Fault):
     """Deliver SIGTERM to this very process at the step — the
     preemption notice. The state passes through untouched; the
@@ -221,14 +331,44 @@ class FaultInjector:
     def raise_at(cls, step, error, once=True, label=""):
         return cls([RaiseFault(step, error, once=once)], label=label)
 
+    @classmethod
+    def device_subset(cls, step, device_ids=None, count=None, mesh=None,
+                      axis=None, index=None, once=False, label=""):
+        return cls([DeviceSubsetFault(step, device_ids=device_ids,
+                                      count=count, mesh=mesh, axis=axis,
+                                      index=index, once=once)],
+                   label=label)
+
+    @classmethod
+    def from_env(cls, label=""):
+        """The env-knob drill harness: an injector armed from
+        ``PYSTELLA_FAULT_DEVICE_SUBSET`` (``"<step>:<count>"``; unset
+        -> ``None``), persistence from
+        ``PYSTELLA_FAULT_DEVICE_SUBSET_PERSIST``. Drivers opt in —
+        e.g. a production supervisor rehearsing its own remesh path."""
+        from pystella_tpu import config as _config
+        spec = _config.getenv("PYSTELLA_FAULT_DEVICE_SUBSET")
+        if not spec:
+            return None
+        persist = _config.get_bool("PYSTELLA_FAULT_DEVICE_SUBSET_PERSIST")
+        return cls([DeviceSubsetFault.from_spec(spec,
+                                                once=not persist)],
+                   label=label)
+
     # -- the injection point -----------------------------------------------
 
     def apply(self, step, state):
         """Fire every armed fault scheduled for ``step``; returns the
         (possibly corrupted) state, or raises what a raising fault
-        raised."""
+        raised. A fault exposing ``still_applies(state)`` (the
+        device-subset persistence probe) is consulted first, so a
+        remeshed program replaying past a persistent loss neither
+        re-raises nor spams ``fault_injected`` events."""
         for fault in self.faults:
             if fault.should_fire(step):
+                check = getattr(fault, "still_applies", None)
+                if check is not None and not check(state):
+                    continue
                 desc = fault.describe()
                 # "kind"/"step" collide with emit()'s own parameters
                 desc["fault_kind"] = desc.pop("kind")
@@ -237,6 +377,18 @@ class FaultInjector:
                              label=self.label, **desc)
                 state = fault.fire(state)
         return state
+
+    def lost_devices(self):
+        """Every device a fired :class:`DeviceSubsetFault` has taken —
+        the deterministic survivor probe
+        :class:`~pystella_tpu.resilience.remesh.RemeshPlanner` uses in
+        single-process drills."""
+        lost = []
+        for f in self.faults:
+            for d in getattr(f, "lost", ()):
+                if d not in lost:
+                    lost.append(d)
+        return lost
 
     @property
     def fired(self):

@@ -1,0 +1,443 @@
+"""The re-mesh library: degraded-mesh continuation after device loss.
+
+PR 8's :class:`~pystella_tpu.resilience.Supervisor` made device loss
+survivable but stopped at the edge of the real problem: its ``remesh``
+hook handed the caller the unsolved job of rebuilding a valid mesh,
+resharding state, and reconstructing a step function from the
+survivors. This module is that job, as a library — the
+decomposition-mapping decision the MPI-X/Mapple line of work solves
+statically (PAPERS.md arxiv 2312.13094 / 2507.17087), re-solved at
+runtime against whatever hardware is still alive:
+
+1. **Solve** — :func:`feasible_proc_shapes` enumerates every mesh over
+   the surviving device set and applies the feasibility rules the
+   kernel tiers actually enforce (grid divisibility per sharded axis,
+   halo width within the local block, pencil-FFT transpose
+   divisibility when spectra are in play); the best feasible candidate
+   wins (most devices, then least halo surface, then an unsharded z —
+   the production layout preference), and every rejected candidate is
+   recorded WITH its reason so the ``remesh_plan`` event is an
+   auditable decision, not an oracle. Ensemble decompositions
+   (:func:`~pystella_tpu.ensemble_mesh`) instead shrink the member
+   axis: the per-member lattice sharding is kept and the ensemble
+   device extent drops to the largest survivor-fitting divisor of the
+   member count (E members over D' devices repack as E/D' per slice).
+2. **Reshard** — the last durable checkpoint is restored straight onto
+   the degraded mesh via :meth:`pystella_tpu.Checkpointer.restore`'s
+   ``mesh=`` template path: orbax reads each device's shard directly
+   from disk, so the full state is NEVER materialized on one device
+   (the failure mode that would OOM exactly when the fleet is already
+   on fire).
+3. **Rebuild** — the step function is reconstructed through the same
+   constructors that built the original program: the planner carries a
+   declarative ``build_step(decomp) -> step_fn`` factory (a closure
+   over :class:`~pystella_tpu.Stepper` / ``FusedScalarStepper`` /
+   :class:`~pystella_tpu.ensemble.EnsembleStepper` construction), so
+   the generic, fused, batched, and step-with-health tiers all come
+   back on the new mesh and sentinel/monitor/forensics keep working
+   unchanged.
+
+Wired in as the Supervisor's **default** remesh policy (pass
+``planner=``; the legacy ``remesh=`` hook becomes an override), a
+supervised run that loses devices mid-flight completes on the degraded
+mesh with no caller-provided recovery code::
+
+    planner = RemeshPlanner(decomp, grid_shape, build_step)
+    sup = Supervisor(step_fn, ck, nsteps, monitor=mon, planner=planner)
+    report = sup.run(state)       # 8 devices -> fault -> 4 devices
+
+Survivor resolution, in priority order: an explicit ``devices_fn``;
+the fault injector's lost-device registry
+(:meth:`~pystella_tpu.resilience.faults.FaultInjector.lost_devices`
+— the deterministic tier-1 drills); the post-re-dial device probe
+(:func:`pystella_tpu.parallel.multihost.live_devices` — real
+hardware, where a re-dialed smaller cluster simply reports fewer
+devices).
+
+Every invocation emits a ``remesh_plan`` run event naming old -> new
+mesh, survivors, and the rejected candidates; the ledger folds it into
+the ``resilience`` report section's ``degraded`` block and the gate
+refuses reports that claim full-mesh throughput from a degraded run
+(``doc/resilience.md`` "Re-mesh and degraded continuation").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pystella_tpu.obs import events as _events
+
+__all__ = ["RemeshPlan", "RemeshPlanner", "feasible_proc_shapes",
+           "proc_shape_candidates"]
+
+
+def proc_shape_candidates(ndev):
+    """Every ordered 3-axis factorization ``(px, py, pz)`` with
+    ``px * py * pz == ndev``, deterministically ordered."""
+    ndev = int(ndev)
+    out = []
+    for px in range(1, ndev + 1):
+        if ndev % px:
+            continue
+        rest = ndev // px
+        for py in range(1, rest + 1):
+            if rest % py:
+                continue
+            out.append((px, py, rest // py))
+    return out
+
+
+def _halo_surface(grid_shape, proc_shape, halo):
+    """Per-step exchanged halo sites of a candidate mesh — the
+    surface-to-volume score the solver minimizes (two ``halo[d]``-wide
+    slabs per sharded axis; unsharded axes wrap locally for free)."""
+    total = 0
+    for d in range(3):
+        if proc_shape[d] > 1 and halo[d] > 0:
+            slab = 2 * halo[d]
+            for a in range(3):
+                if a != d:
+                    slab *= grid_shape[a] // proc_shape[a]
+            total += slab * int(np.prod(proc_shape))
+    return total
+
+
+def feasible_proc_shapes(grid_shape, ndev, halo=(0, 0, 0),
+                         pencil=False):
+    """``(feasible, rejected)`` for every 3-axis mesh over exactly
+    ``ndev`` devices: ``feasible`` is best-first (least halo surface,
+    then unsharded-z preferred, then lexicographic), ``rejected`` is a
+    list of ``{"proc_shape", "reason"}`` records naming why each
+    infeasible candidate was turned down — the audit trail the
+    ``remesh_plan`` event carries.
+
+    Rules (exactly what the kernel tiers enforce at construction):
+
+    - every sharded axis must divide its grid extent
+      (:meth:`~pystella_tpu.DomainDecomposition.rank_shape`);
+    - the halo width must fit within the local block
+      (``halo[d] <= grid[d] // p[d]`` — the ``pad_with_halos`` guard);
+    - with ``pencil=True``, the grid's x and y extents must divide the
+      TOTAL device count (the pencil-FFT transpose stages redistribute
+      those axes over all devices —
+      :func:`pystella_tpu.fourier.pencil.pencil_feasible`).
+    """
+    grid_shape = tuple(int(n) for n in grid_shape)
+    if np.isscalar(halo):
+        halo = (halo,) * 3
+    halo = tuple(int(h) for h in halo)
+    feasible, rejected = [], []
+    for cand in proc_shape_candidates(ndev):
+        reason = None
+        for d in range(3):
+            if grid_shape[d] % cand[d]:
+                reason = (f"grid axis {d} ({grid_shape[d]}) not "
+                          f"divisible by mesh axis {cand[d]}")
+                break
+            # the pad_with_halos guard holds for unsharded axes too
+            # (the local periodic wrap slices halo[d] rows)
+            if halo[d] > grid_shape[d] // cand[d]:
+                reason = (f"halo {halo[d]} exceeds the local block "
+                          f"{grid_shape[d] // cand[d]} along axis {d}")
+                break
+        if reason is None and pencil and ndev > 1:
+            for d, label in ((0, "x"), (1, "y")):
+                if grid_shape[d] % ndev:
+                    reason = (f"pencil FFT: grid {label}="
+                              f"{grid_shape[d]} not divisible by the "
+                              f"total device count {ndev}")
+                    break
+        if reason is None:
+            feasible.append(cand)
+        else:
+            rejected.append({"proc_shape": list(cand), "reason": reason})
+    feasible.sort(key=lambda p: (_halo_surface(grid_shape, p, halo),
+                                 p[2] > 1, p))
+    return feasible, rejected
+
+
+class RemeshPlan:
+    """One solved degraded-mesh decision (JSON-safe via
+    :meth:`describe`). ``changed`` is False when every old device
+    survived — a transport blip, not a loss — in which case the
+    supervisor keeps the original program."""
+
+    def __init__(self, *, old_proc_shape, new_proc_shape, devices,
+                 survivors, lost, rejected, changed,
+                 old_ensemble=None, new_ensemble=None, members=None,
+                 pencil=False):
+        self.old_proc_shape = tuple(old_proc_shape)
+        self.new_proc_shape = (tuple(new_proc_shape)
+                               if new_proc_shape is not None else None)
+        #: the survivor subset the new mesh actually uses (ordered)
+        self.devices = list(devices)
+        self.survivors = list(survivors)
+        self.lost = list(lost)
+        self.rejected = list(rejected)
+        self.changed = bool(changed)
+        self.old_ensemble = old_ensemble
+        self.new_ensemble = new_ensemble
+        self.members = members
+        self.pencil = bool(pencil)
+
+    @property
+    def feasible(self):
+        return self.new_proc_shape is not None
+
+    @staticmethod
+    def _ids(devices):
+        return [int(getattr(d, "id", d)) for d in devices]
+
+    def describe(self):
+        """The ``remesh_plan`` event payload: old -> new mesh,
+        survivors, and the rejected candidates."""
+        out = {
+            "old_proc_shape": list(self.old_proc_shape),
+            "new_proc_shape": (list(self.new_proc_shape)
+                               if self.new_proc_shape else None),
+            "devices": self._ids(self.devices),
+            "survivors": self._ids(self.survivors),
+            "lost": self._ids(self.lost),
+            "n_rejected": len(self.rejected),
+            "rejected": self.rejected[:8],
+            "changed": self.changed,
+            "feasible": self.feasible,
+        }
+        if self.old_ensemble is not None:
+            out["ensemble"] = {"old": self.old_ensemble,
+                               "new": self.new_ensemble,
+                               "members": self.members}
+        if self.pencil:
+            out["pencil"] = True
+        return out
+
+
+class RemeshPlanner:
+    """Solve + reshard + rebuild after device loss (module docstring).
+
+    :arg decomp: the CURRENT
+        :class:`~pystella_tpu.DomainDecomposition` (spatial or
+        ensemble); carries the mesh, halo widths, and axis names the
+        degraded decomposition inherits.
+    :arg grid_shape: the 3-D lattice extents feasibility is solved
+        against (one member's lattice for an ensemble decomposition).
+    :arg build_step: ``build_step(new_decomp) -> step_fn`` — the
+        declarative program factory, a closure over the SAME
+        constructors that built the original program (stepper, fused
+        kernels, :class:`~pystella_tpu.ensemble.EnsembleStepper`, the
+        step-with-health tier...); called once per realized plan. May
+        also return a dict (any subset of ``step_fn`` / ``restore_fn``
+        / ``monitor`` / ``note``) for callers that rebuild more than
+        the step callable.
+    :arg halo: halo widths for the feasibility rule (default: the
+        decomposition's ``halo_shape``).
+    :arg needs_pencil_fft: require pencil-FFT transpose divisibility of
+        every candidate (set when the run computes spectra through the
+        pencil tier — a degraded mesh that breaks the transform is not
+        a continuation).
+    :arg members: ensemble member count (enables the member-axis
+        shrink rule: the new ensemble extent must divide it so E
+        members repack as E/D' per slice).
+    :arg devices_fn: optional zero-arg callable returning the surviving
+        devices (overrides the injector/probe resolution).
+    :arg label: tag carried on emitted events.
+    """
+
+    def __init__(self, decomp, grid_shape, build_step, *, halo=None,
+                 needs_pencil_fft=False, members=None, devices_fn=None,
+                 label=""):
+        self.decomp = decomp
+        self.grid_shape = tuple(int(n) for n in grid_shape)
+        self.build_step = build_step
+        if halo is None:
+            halo = getattr(decomp, "halo_shape", (0, 0, 0))
+        if np.isscalar(halo):
+            halo = (halo,) * 3
+        self.halo = tuple(int(h) for h in halo)
+        self.needs_pencil_fft = bool(needs_pencil_fft)
+        self.members = None if members is None else int(members)
+        self.devices_fn = devices_fn
+        self.label = label
+        #: the last realized plan (None before any remesh)
+        self.last_plan = None
+
+    # -- survivor resolution ------------------------------------------------
+
+    def mesh_devices(self):
+        """The current mesh's devices, flat, in mesh order."""
+        return list(self.decomp.mesh.devices.flat)
+
+    def survivors(self, faults=None):
+        """The surviving device list: ``devices_fn`` > the injector's
+        lost-device registry (deterministic drills) > the post-re-dial
+        probe (:func:`~pystella_tpu.parallel.multihost.live_devices`),
+        intersected with the old mesh's device set."""
+        old = self.mesh_devices()
+        if self.devices_fn is not None:
+            return list(self.devices_fn())
+        lost = set()
+        if faults is not None:
+            getter = getattr(faults, "lost_devices", None)
+            if getter is not None:
+                lost = set(getter())
+        if lost:
+            return [d for d in old if d not in lost]
+        from pystella_tpu.parallel import multihost
+        live = set(multihost.live_devices())
+        return [d for d in old if d in live]
+
+    # -- the solver ----------------------------------------------------------
+
+    def plan(self, survivors):
+        """Solve for the best feasible degraded mesh over
+        ``survivors``; returns a :class:`RemeshPlan` (``feasible``
+        False when no candidate works at any usable device count)."""
+        old = self.mesh_devices()
+        survivors = list(survivors)
+        surv_set = set(survivors)
+        lost = [d for d in old if d not in surv_set]
+        if self.decomp.ensemble_axis is not None:
+            return self._plan_ensemble(old, survivors, lost)
+        old_shape = tuple(self.decomp.proc_shape)
+        if not lost:
+            return RemeshPlan(
+                old_proc_shape=old_shape, new_proc_shape=old_shape,
+                devices=old, survivors=survivors, lost=[], rejected=[],
+                changed=False, pencil=self.needs_pencil_fft)
+        rejected = []
+        for ndev in range(len(survivors), 0, -1):
+            feasible, rej = feasible_proc_shapes(
+                self.grid_shape, ndev, halo=self.halo,
+                pencil=self.needs_pencil_fft)
+            rejected.extend(rej)
+            if feasible:
+                best = feasible[0]
+                return RemeshPlan(
+                    old_proc_shape=old_shape, new_proc_shape=best,
+                    devices=survivors[:ndev], survivors=survivors,
+                    lost=lost, rejected=rejected, changed=True,
+                    pencil=self.needs_pencil_fft)
+        return RemeshPlan(
+            old_proc_shape=old_shape, new_proc_shape=None,
+            devices=[], survivors=survivors, lost=lost,
+            rejected=rejected, changed=True,
+            pencil=self.needs_pencil_fft)
+
+    def _plan_ensemble(self, old, survivors, lost):
+        """The member-axis shrink rule: spatial sharding per member is
+        kept; the ensemble extent drops to the largest
+        survivor-fitting value that divides the member count."""
+        spatial_shape = tuple(self.decomp.proc_shape)
+        spatial = int(np.prod(spatial_shape))
+        old_ens = self.decomp.ensemble_devices
+        if not lost:
+            return RemeshPlan(
+                old_proc_shape=spatial_shape,
+                new_proc_shape=spatial_shape, devices=old,
+                survivors=survivors, lost=[], rejected=[],
+                changed=False, old_ensemble=old_ens,
+                new_ensemble=old_ens, members=self.members)
+        rejected = []
+        best = None
+        for d in range(len(survivors) // spatial, 0, -1):
+            if self.members is not None and self.members % d:
+                rejected.append({
+                    "proc_shape": [d, *spatial_shape],
+                    "reason": f"ensemble extent {d} does not divide "
+                              f"the member count {self.members}"})
+                continue
+            best = d
+            break
+        if best is None:
+            return RemeshPlan(
+                old_proc_shape=spatial_shape, new_proc_shape=None,
+                devices=[], survivors=survivors, lost=lost,
+                rejected=rejected, changed=True,
+                old_ensemble=old_ens, new_ensemble=None,
+                members=self.members)
+        return RemeshPlan(
+            old_proc_shape=spatial_shape, new_proc_shape=spatial_shape,
+            devices=survivors[:best * spatial], survivors=survivors,
+            lost=lost, rejected=rejected, changed=True,
+            old_ensemble=old_ens, new_ensemble=best,
+            members=self.members)
+
+    # -- realization ---------------------------------------------------------
+
+    def make_decomp(self, plan):
+        """The degraded :class:`~pystella_tpu.DomainDecomposition` a
+        feasible plan names (same halo widths and axis names, over the
+        survivor subset)."""
+        from pystella_tpu.parallel.decomp import (
+            DomainDecomposition, ensemble_mesh)
+        if not plan.feasible:
+            raise ValueError(
+                "no feasible degraded mesh: "
+                + "; ".join(r["reason"] for r in plan.rejected[:4]))
+        if self.decomp.ensemble_axis is not None:
+            mesh = ensemble_mesh(
+                plan.new_proc_shape,
+                ensemble_devices=plan.new_ensemble,
+                axis_names=self.decomp.axis_names,
+                ensemble_axis=self.decomp.ensemble_axis,
+                devices=plan.devices)
+            return DomainDecomposition(
+                mesh=mesh, halo_shape=self.decomp.halo_shape,
+                ensemble_axis=self.decomp.ensemble_axis)
+        return self.decomp.with_devices(plan.devices,
+                                        plan.new_proc_shape)
+
+    def realize(self, plan):
+        """Build the swap for a feasible plan: the degraded decomp, the
+        rebuilt step function, and the placement half of the resume.
+        Returns the supervisor swap dict (``step_fn`` / ``restore_fn``
+        / ``decomp`` / ``plan`` / ``note``)."""
+        new_decomp = self.make_decomp(plan)
+        built = self.build_step(new_decomp)
+        swap = {}
+        if isinstance(built, dict):
+            swap.update(built)
+        else:
+            swap["step_fn"] = built
+        swap.setdefault(
+            "restore_fn",
+            new_decomp.shard_members
+            if new_decomp.ensemble_axis is not None else new_decomp.shard)
+        swap.setdefault("decomp", new_decomp)
+        swap["plan"] = plan
+        ens = (f", ensemble {plan.old_ensemble}->{plan.new_ensemble}"
+               if plan.old_ensemble is not None else "")
+        swap.setdefault(
+            "note",
+            f"re-meshed {list(plan.old_proc_shape)} -> "
+            f"{list(plan.new_proc_shape)}{ens} over "
+            f"{len(plan.devices)} of {len(plan.devices) + len(plan.lost)}"
+            " devices")
+        self.last_plan = plan
+        self.decomp = new_decomp
+        return swap
+
+    # -- the supervisor's default policy -------------------------------------
+
+    def __call__(self, error, attempt, *, faults=None, step=None):
+        """One remesh decision during device-loss recovery (what the
+        supervisor invokes when no ``remesh`` hook overrides it).
+        Emits ``remesh_plan``; returns the swap dict, or ``None`` when
+        every old device survived (transport blip — keep the program).
+        An infeasible plan raises ``RuntimeError`` (deterministic:
+        counted against the recovery budget, never retried into)."""
+        survivors = self.survivors(faults=faults)
+        plan = self.plan(survivors)
+        _events.emit("remesh_plan", step=step, label=self.label,
+                     attempt=int(attempt),
+                     error=f"{type(error).__name__}: {error}",
+                     **plan.describe())
+        if not plan.changed:
+            return None
+        if not plan.feasible:
+            raise RuntimeError(
+                "remesh infeasible: no degraded mesh serves grid "
+                f"{self.grid_shape} on {len(survivors)} surviving "
+                "device(s): "
+                + "; ".join(r["reason"] for r in plan.rejected[:4]))
+        return self.realize(plan)

@@ -22,11 +22,20 @@ the recovery machinery the ROADMAP's pod-scale item calls for:
 - **recovery** — under a jittered-backoff
   :class:`~pystella_tpu.resilience.retry.Retrier`: re-dial the
   multi-controller runtime (:func:`pystella_tpu.parallel.multihost.
-  reinit` — no longer a one-way latch), optionally re-mesh to the
-  surviving devices through the ``remesh`` hook (emitting
-  ``run_degraded``), finalize pending checkpoint writes, restore from
-  the durable last-good checkpoint (walking back past a torn newest
-  one), and **replay at most one checkpoint interval** of steps.
+  reinit` — no longer a one-way latch), re-mesh to the surviving
+  devices — by default through the
+  :class:`~pystella_tpu.resilience.remesh.RemeshPlanner` given as
+  ``planner=`` (solve a feasible degraded mesh, rebuild the step
+  function through the original constructors, emit ``remesh_plan`` +
+  ``run_degraded``), with the legacy ``remesh`` hook as an override —
+  finalize pending checkpoint writes, restore from the durable
+  last-good checkpoint (walking back past a torn newest one; a
+  re-meshed run restores STRAIGHT onto the degraded mesh through
+  :meth:`Checkpointer.restore`'s ``mesh=`` template path, never
+  materializing the state on one device), and **replay at most one
+  checkpoint interval** of steps. A swap also refreshes the monitor's
+  decomposition-derived state (:meth:`HealthMonitor.reset`) so
+  sentinel field specs and checkpoint sharding track the new mesh.
 - **preemption** — SIGTERM sets a flag; at the next step boundary the
   supervisor drains the monitor, takes a synchronous durable
   checkpoint, emits ``run_preempted``, and returns cleanly so a
@@ -111,12 +120,22 @@ class Supervisor:
         :class:`RecoveryFailed`.
     :arg remesh: optional hook ``remesh(error, attempt) -> None | dict``
         called during device-loss recovery; returning
-        ``{"step_fn": ..., "restore_fn": ..., "note": ...}`` (any
-        subset) swaps in a re-meshed program for the surviving devices
-        and emits ``run_degraded``.
+        ``{"step_fn": ..., "restore_fn": ..., "decomp": ...,
+        "monitor": ..., "note": ...}`` (any subset) swaps in a
+        re-meshed program for the surviving devices and emits
+        ``run_degraded``. When set it OVERRIDES ``planner``.
+    :arg planner: optional
+        :class:`~pystella_tpu.resilience.remesh.RemeshPlanner` — the
+        DEFAULT remesh policy: on device-loss recovery (and no
+        ``remesh`` hook) it resolves the survivors, solves the best
+        feasible degraded mesh (emitting ``remesh_plan``), rebuilds
+        the step function through the original constructors, and the
+        restore lands straight on the new mesh.
     :arg redial: re-initialize the multi-controller runtime during
         device-loss recovery (default ``True``; a single-process run's
-        re-dial is a no-op).
+        re-dial is a no-op). A CALLABLE replaces the default
+        ``multihost.reinit()`` — e.g. a multi-process drill re-dialing
+        as a smaller cluster with explicit coordinator arguments.
     :arg metadata_fn: optional ``metadata_fn(step, state) -> dict``
         merged into every checkpoint's metadata.
     :arg keep_initial: keep a host-side copy of the initial state so a
@@ -133,8 +152,8 @@ class Supervisor:
     def __init__(self, step_fn, checkpointer, nsteps, *, monitor=None,
                  checkpoint_every=None, restore_fn=None, faults=None,
                  retry=None, max_recoveries=None, remesh=None,
-                 redial=True, metadata_fn=None, keep_initial=True,
-                 install_sigterm=True, label=""):
+                 planner=None, redial=True, metadata_fn=None,
+                 keep_initial=True, install_sigterm=True, label=""):
         self.step_fn = step_fn
         self.checkpointer = checkpointer
         self.nsteps = int(nsteps)
@@ -151,7 +170,11 @@ class Supervisor:
             max_recoveries if max_recoveries is not None
             else _config.get_int("PYSTELLA_RESILIENCE_MAX_RECOVERIES"))
         self.remesh = remesh
-        self.redial = bool(redial)
+        self.planner = planner
+        #: set by a re-mesh swap: restores then land straight on this
+        #: decomposition's mesh (the Checkpointer mesh= template path)
+        self.restore_decomp = None
+        self.redial = redial if callable(redial) else bool(redial)
         self.metadata_fn = metadata_fn
         self.keep_initial = bool(keep_initial)
         self.install_sigterm = bool(install_sigterm)
@@ -318,8 +341,15 @@ class Supervisor:
             self.checkpointer.finalize()
 
     def _restore(self):
-        step, state, meta = self.checkpointer.restore(
-            sharding_fn=self.restore_fn)
+        if self.restore_decomp is not None:
+            # a re-meshed run: restore straight onto the degraded mesh
+            # (orbax reads each device's shard directly — the state is
+            # never materialized on one device)
+            step, state, meta = self.checkpointer.restore(
+                mesh=self.restore_decomp)
+        else:
+            step, state, meta = self.checkpointer.restore(
+                sharding_fn=self.restore_fn)
         return int(step), state, meta
 
     def _restore_or_restart(self):
@@ -353,8 +383,32 @@ class Supervisor:
             "(keep_initial=False)")
 
     def _redial(self):
+        if callable(self.redial):
+            self.redial()
+            return
         from pystella_tpu.parallel import multihost
         multihost.reinit()
+
+    def _apply_swap(self, swap, at_step):
+        """Install a re-meshed program (from the ``remesh`` hook or the
+        planner): swap the step function, point restores at the new
+        mesh, and refresh the monitor's decomposition-derived state —
+        a swapped mesh must not leave the monitor checking vectors
+        (or the checkpointer placing shards) against the old
+        sharding."""
+        self.step_fn = swap.get("step_fn", self.step_fn)
+        self.restore_fn = swap.get("restore_fn", self.restore_fn)
+        if swap.get("decomp") is not None:
+            self.restore_decomp = swap["decomp"]
+        if "monitor" in swap:
+            self.monitor = swap["monitor"]
+        elif self.monitor is not None:
+            reset = getattr(self.monitor, "reset", None)
+            if reset is not None:
+                reset()
+        _events.emit("run_degraded", step=at_step, label=self.label,
+                     note=swap.get("note", "re-meshed to surviving "
+                                   "devices"))
 
     def _finalize_bounded(self, timeout_s):
         """The durability barrier, with a wall bound — ONLY for the
@@ -436,18 +490,15 @@ class Supervisor:
                 if kind == "device_loss":
                     if self.redial:
                         self._redial()
-                    if self.remesh is not None:
+                    swap = None
+                    if self.remesh is not None:       # hook overrides
                         swap = self.remesh(error, attempt)
-                        if swap:
-                            self.step_fn = swap.get("step_fn",
-                                                    self.step_fn)
-                            self.restore_fn = swap.get("restore_fn",
-                                                       self.restore_fn)
-                            _events.emit(
-                                "run_degraded", step=at_step,
-                                label=self.label,
-                                note=swap.get("note", "re-meshed to "
-                                              "surviving devices"))
+                    elif self.planner is not None:    # default policy
+                        swap = self.planner(error, attempt,
+                                            faults=self.faults,
+                                            step=at_step)
+                    if swap:
+                        self._apply_swap(swap, at_step)
                 # scheduled-but-unconfirmed writes must settle before a
                 # read; a torn one is walked back over by restore().
                 # Bounded: a barrier wedged by the very device loss

@@ -172,7 +172,8 @@ class Checkpointer:
     def all_steps(self):
         return sorted(self._mngr.all_steps())
 
-    def restore(self, step=None, template=None, sharding_fn=None):
+    def restore(self, step=None, template=None, sharding_fn=None,
+                mesh=None):
         """Restore ``(step, state, metadata)``.
 
         :arg step: which checkpoint (default: newest). An EXPLICIT step
@@ -183,6 +184,18 @@ class Checkpointer:
             when given, arrays are restored directly onto its shardings.
         :arg sharding_fn: convenience alternative — a callable applied to
             each restored (host) array, e.g. ``decomp.shard``.
+        :arg mesh: the re-mesh path — a
+            :class:`~pystella_tpu.DomainDecomposition` (or a raw
+            ``jax.sharding.Mesh``, wrapped into one) the checkpoint is
+            restored ONTO, which need not be the mesh it was written
+            on. The restore template is built from the checkpoint's
+            own on-disk array metadata (shapes/dtypes) with this
+            decomposition's shardings, so orbax reads each device's
+            shard straight from disk — a host-staged reshard that
+            never materializes the full state on one device. Lattice
+            leaves (rank >= 3) take the lattice sharding, batched
+            leaves of an ensemble decomposition take the member-axis
+            sharding, and low-rank leaves replicate.
 
         With ``step=None`` the restore **walks back**: a corrupt or
         partial newest checkpoint (orbax raises mid-restore — the torn
@@ -192,7 +205,8 @@ class Checkpointer:
         error propagate.
         """
         if step is not None:
-            return self._restore_one(int(step), template, sharding_fn)
+            return self._restore_one(int(step), template, sharding_fn,
+                                     mesh)
         candidates = sorted(self._mngr.all_steps(), reverse=True)
         if not candidates:
             raise FileNotFoundError(
@@ -200,7 +214,8 @@ class Checkpointer:
         last_err = None
         for cand in candidates:
             try:
-                return self._restore_one(cand, template, sharding_fn)
+                return self._restore_one(cand, template, sharding_fn,
+                                         mesh)
             except Exception as e:  # noqa: BLE001 — walk back, then re-raise
                 last_err = e
                 _events.emit("checkpoint_fallback", step=cand,
@@ -208,8 +223,49 @@ class Checkpointer:
                              error=f"{type(e).__name__}: {e}")
         raise last_err
 
-    def _restore_one(self, step, template=None, sharding_fn=None):
+    def _mesh_template(self, step, mesh):
+        """Restore template for ``mesh=``: the checkpoint's own on-disk
+        shapes/dtypes, placed with the target decomposition's
+        shardings."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        decomp = mesh
+        if not hasattr(decomp, "sharding"):
+            from pystella_tpu.parallel.decomp import DomainDecomposition
+            decomp = DomainDecomposition(mesh=mesh)
+        meta = self._mngr.item_metadata(int(step))["state"]
+        n_lat = len(decomp.axis_names)
+
+        def placement(ndim):
+            if decomp.ensemble_axis is not None:
+                if ndim >= 1 + n_lat:
+                    return decomp.member_sharding(ndim - 1 - n_lat)
+                if ndim >= 1:
+                    # per-member scalars/vectors: member axis only
+                    lead = (decomp.ensemble_axis
+                            if decomp.ensemble_devices > 1 else None)
+                    return NamedSharding(
+                        decomp.mesh,
+                        PartitionSpec(*((lead,)
+                                        + (None,) * (ndim - 1))))
+            elif ndim >= n_lat:
+                return decomp.sharding(ndim - n_lat)
+            return NamedSharding(decomp.mesh,
+                                 PartitionSpec(*((None,) * ndim)))
+
+        def to_struct(m):
+            shape = tuple(int(n) for n in m.shape)
+            return jax.ShapeDtypeStruct(shape, m.dtype,
+                                        sharding=placement(len(shape)))
+
+        return jax.tree_util.tree_map(to_struct, meta)
+
+    def _restore_one(self, step, template=None, sharding_fn=None,
+                     mesh=None):
         ocp = self._ocp
+        if template is None and mesh is not None:
+            template = self._mesh_template(step, mesh)
         args = {}
         if template is not None:
             args["state"] = ocp.args.StandardRestore(template)

@@ -106,6 +106,20 @@ class HealthMonitor:
         being rolled back. Returns the number dropped."""
         return 0 if self._mon is None else self._mon.discard()
 
+    def reset(self):
+        """Forget all decomposition-derived state — the re-mesh path:
+        a supervisor swapping in a degraded-mesh program calls this so
+        the next :meth:`observe` rebuilds the sentinel (field specs,
+        jitted health computation) against the NEW state placement
+        instead of checking vectors against the old sharding. Pending
+        vectors are dropped unchecked (they describe the pre-loss
+        trajectory; the recovery already discarded the corrupt ones).
+        Returns the number dropped."""
+        n = self.discard()
+        self._mon = None
+        self._names = None
+        return n
+
     @property
     def checked_through(self):
         """Highest step actually health-checked so far (None before the

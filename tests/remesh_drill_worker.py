@@ -1,0 +1,168 @@
+"""Worker for the re-mesh continuation drills (tests/test_remesh.py).
+
+Two modes:
+
+- ``--dry-run`` — single process, 8 virtual CPU devices: the full
+  degraded-continuation drill driven entirely by the ENV knobs
+  (``PYSTELLA_FAULT_DEVICE_SUBSET`` arms a persistent device-subset
+  fault through ``FaultInjector.from_env()``): a supervised (2,2,2)
+  run loses half the mesh mid-run, the ``RemeshPlanner`` (the
+  supervisor's default policy — no remesh hook anywhere in this file)
+  solves a 4-device mesh, the checkpoint restores straight onto it,
+  and the run finishes bit-consistent with an uninterrupted run on the
+  degraded mesh's own trajectory. This is the tier-1 rehearsal of the
+  exact code path the real mode runs.
+
+- real mode (``--coordinator ... --process-id N --nproc M``) — a true
+  ≥2-process ``jax.distributed`` cluster (each process contributing 4
+  virtual CPU devices, one global (2,2,2) mesh). The victim process
+  SIGKILLs itself mid-step; the survivor's next dispatch raises
+  ``UNAVAILABLE``, its supervisor re-dials DOWN to a single-process
+  runtime (``redial=`` callable), the planner resolves survivors from
+  its own local devices, and the run continues degraded on them.
+  Gated like tests/test_multihost.py: jax 0.4.x cannot execute
+  cross-process collectives on the CPU backend, so the real mode is
+  slow-marked and re-arms on jax >= 0.5.
+
+Each run prints ONE JSON verdict line on stdout; the test parses it.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GRID = (16, 16, 16)
+NSTEPS = 12
+EVERY = 4
+
+
+def build_step_factory(ps, np, jax):
+    """``build_step(decomp) -> step_fn`` — through the ordinary
+    constructors (FiniteDifferencer + jit), rebuilt per mesh."""
+    def build_step(dec):
+        fd = ps.FiniteDifferencer(dec, 1, (0.1, 0.1, 0.1))
+
+        @jax.jit
+        def stepf(st):
+            return {"f": st["f"] * np.float32(0.99)
+                    + np.float32(1e-3) * fd.lap(st["f"])}
+
+        return lambda st, i: stepf(st)
+    return build_step
+
+
+def initial_host_state(np):
+    rng = np.random.default_rng(17)
+    return {"f": 1e-2 * rng.standard_normal(GRID).astype(np.float32)}
+
+
+def main():
+    ap = argparse.ArgumentParser(prog="remesh_drill_worker.py")
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--process-id", type=int, default=0)
+    ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument("--victim", type=int, default=1)
+    ap.add_argument("--kill-step", type=int, default=6)
+    ap.add_argument("--ckdir", required=True)
+    ap.add_argument("--events", default=None)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    per_proc = 8 if args.dry_run else 4
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={per_proc}"
+        ).strip()
+    if args.dry_run:
+        # the env-knob drill harness: lose the last 4 devices entering
+        # step 9 (persistent — lost hardware stays lost)
+        os.environ.setdefault("PYSTELLA_FAULT_DEVICE_SUBSET", "9:4")
+
+    import numpy as np
+    import jax
+    sys.path.insert(0, REPO)
+    import pystella_tpu as ps
+    from pystella_tpu import resilience as rz
+    from pystella_tpu.obs import events
+    from pystella_tpu.parallel import multihost
+
+    if args.events:
+        events.configure(args.events)
+    if not args.dry_run:
+        multihost.init_multihost(
+            coordinator_address=args.coordinator,
+            num_processes=args.nproc, process_id=args.process_id)
+
+    devices = jax.devices()[:8]
+    dec = ps.DomainDecomposition((2, 2, 2), devices=devices)
+    build_step = build_step_factory(ps, np, jax)
+    host = initial_host_state(np)
+    state = {k: dec.shard(v) for k, v in host.items()}
+
+    step_fn = build_step(dec)
+    if not args.dry_run and args.process_id == args.victim:
+        inner = step_fn
+
+        def step_fn(st, i):  # noqa: F811 — the victim's dying step
+            if i == args.kill_step:
+                os.kill(os.getpid(), signal.SIGKILL)
+            return inner(st, i)
+
+    if args.dry_run:
+        faults = rz.FaultInjector.from_env(label="drill-dry")
+        devices_fn = None
+        redial = True
+    else:
+        faults = None
+        # the survivor continues on ITS OWN devices: after the victim
+        # host is gone, local devices are exactly what it can vouch for
+        devices_fn = (lambda: jax.local_devices())
+        # re-dial DOWN: tear down the dead 2-process runtime and
+        # re-arm as a single-process (no-op) init
+        redial = (lambda: multihost.reinit())
+
+    planner = rz.RemeshPlanner(dec, GRID, build_step, halo=1,
+                               devices_fn=devices_fn, label="drill")
+    mon = ps.HealthMonitor(every=2, metrics_prefix="supervised")
+    with ps.Checkpointer(args.ckdir, max_to_keep=2) as ck:
+        sup = rz.Supervisor(
+            step_fn, ck, NSTEPS, monitor=mon, checkpoint_every=EVERY,
+            planner=planner, faults=faults, redial=redial,
+            retry=rz.RetryPolicy(base_s=0.05, max_s=0.2, jitter=0.0),
+            label="drill")
+        rep = sup.run(state)
+
+    # reference: the degraded mesh's own uninterrupted trajectory
+    plan = planner.last_plan
+    ref_dec = planner.decomp if plan is not None else dec
+    ref_step = build_step(ref_dec)
+    ref = {k: ref_dec.shard(v) for k, v in host.items()}
+    for i in range(NSTEPS):
+        ref = ref_step(ref, i)
+    bit = all(np.array_equal(np.asarray(rep["state"][k]),
+                             np.asarray(ref[k])) for k in ref)
+    final_ids = sorted(
+        d.id for d in rep["state"]["f"].sharding.device_set)
+    print(json.dumps({
+        "completed": rep["completed"],
+        "incidents": rep["incidents"],
+        "bit_consistent": bool(bit),
+        "old_mesh": list(plan.old_proc_shape) if plan else None,
+        "new_mesh": (list(plan.new_proc_shape)
+                     if plan and plan.feasible else None),
+        "survivors": len(plan.devices) if plan else None,
+        "final_device_ids": final_ids,
+        "steps_replayed": rep["steps_replayed"],
+    }), flush=True)
+    return 0 if (rep["completed"] and bit and plan is not None) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

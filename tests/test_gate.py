@@ -575,23 +575,36 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     assert evr["member"] is not None and evr["params"]["seed"] == 1
     assert en["chunks"]["count"] > 0
     assert "## Ensemble" in md
-    # the supervised (elastic-runtime) payload ran end to end: an
-    # injected mid-run device-loss fault was survived via restore from
-    # the durable last-good checkpoint — EXACTLY ONE incident with a
+    # the supervised (elastic-runtime) payload AND the re-mesh drill
+    # ran end to end: an injected mid-run device-loss fault survived
+    # via restore-from-last-good, plus a persistent device-subset
+    # fault (half the 8-device mesh lost) survived via the
+    # RemeshPlanner default policy — TWO incidents total, each with a
     # measured MTTR and a replay bounded by the checkpoint interval,
-    # the supervisor's claim consistent with the event record, and the
-    # durability split visible (saves scheduled AND confirmed durable)
+    # the supervisors' claims consistent with the event record, and
+    # the durability split visible (saves scheduled AND durable)
     rz = rep["resilience"]
-    assert rz["n_incidents"] == 1 and rz["resolved"] == 1, rz
+    assert rz["n_incidents"] == 2 and rz["resolved"] == 2, rz
     assert rz["consistent"] is True and rz["completed"] is True
-    rz_inc = rz["incidents"][0]
-    assert rz_inc["kind"] == "device_loss"
-    assert rz_inc["mttr_s"] > 0
-    assert rz_inc["steps_replayed"] <= 4
+    for rz_inc in rz["incidents"]:
+        assert rz_inc["kind"] == "device_loss"
+        assert rz_inc["mttr_s"] > 0
+        assert rz_inc["steps_replayed"] <= 4
     assert rz["checkpoints"]["durable"] >= 2
     assert rz["checkpoints"]["fallbacks"] == 0
-    assert rz["faults_injected"] == 1
+    assert rz["faults_injected"] == 2
     assert "## Resilience" in md
+    # the remesh drill's degraded block: the remesh_plan decision
+    # record (8 -> 4 devices), and the throughput per-chip
+    # normalization flipped to the SURVIVORS — which is exactly what
+    # the gate's degraded-throughput audit accepts below
+    deg = rz["degraded"]
+    assert deg["remesh_plans"], deg
+    assert deg["old_mesh"] == [2, 2, 2]
+    assert deg["devices_used"] == 4 and deg["lost_devices"] == 4
+    assert rep["throughput"]["per_chip"]["basis"] == "surviving"
+    assert rep["throughput"]["per_chip"]["chips"] == 4
+    assert "re-mesh: [2, 2, 2] ->" in md
     # the sharded-spectra payload ran end to end: the pencil FFT tier
     # (explicit all_to_all transposes) timed inside the capture, the
     # report's `fft` section populated — per-call distribution, the
@@ -618,8 +631,8 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     rz_kinds = {r["kind"] for r in events.read_events(
         os.path.join(out, "smoke_events.jsonl"))}
     assert {"fault_injected", "fault_detected", "recovery_attempt",
-            "run_resumed", "checkpoint_durable",
-            "supervisor_done"} <= rz_kinds
+            "run_resumed", "checkpoint_durable", "remesh_plan",
+            "run_degraded", "supervisor_done"} <= rz_kinds
     ens_kinds = {r["kind"] for r in events.read_events(
         os.path.join(out, "smoke_events.jsonl"))}
     assert {"ensemble_run", "ensemble_chunk", "ensemble_done",
@@ -668,7 +681,7 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     # threshold.)
     out2 = str(tmp_path / "bench_results_warm")
     res2 = run_smoke(out2, "--no-ensemble", "--no-supervised",
-                     "--no-spectra")
+                     "--no-spectra", "--no-remesh")
     assert res2.returncode == 0, res2.stderr[-2000:]
     warm = json.load(open(os.path.join(out2, "perf_report.json")))
     warm_cs = warm["cold_start"]
@@ -728,7 +741,7 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     # another interpreter + jax startup against the tier-1 budget).
     slow_deg = dict(slow)
     slow_deg["resilience"] = rep["resilience"]
-    assert rep["resilience"]["faults_injected"] == 1
+    assert rep["resilience"]["faults_injected"] == 2
     slow_deg_path = str(tmp_path / "slow_degraded.json")
     json.dump(slow_deg, open(slow_deg_path, "w"))
     assert gate.main(["--baseline", report_path,
