@@ -936,6 +936,17 @@ def run_smoke(argv=None):
                         "resilience `degraded` block (plus the gate's "
                         "degraded-throughput audit) derives from the "
                         "emitted remesh_plan record")
+    p.add_argument("--no-service", action="store_true",
+                   help="skip the scenario-service payload: the seeded "
+                        "loadgen mix (pystella_tpu.service.loadgen) "
+                        "through a live ScenarioService — mixed "
+                        "tenants/priorities, warm-pool admissions with "
+                        "zero backend compiles on the warm path, one "
+                        "forced cold signature, one quota rejection, "
+                        "and one forced preemption with a "
+                        "bit-consistent resume; the report's `service` "
+                        "section and the gate's SLO verdicts derive "
+                        "from it")
     p.add_argument("--no-spectra", action="store_true",
                    help="skip the sharded-spectra payload: a 16^3 "
                         "2-field power spectrum on the 8-device "
@@ -970,9 +981,13 @@ def run_smoke(argv=None):
     os.makedirs(args.out, exist_ok=True)
     events_path = os.path.join(args.out, "smoke_events.jsonl")
     # fresh record per smoke run: the ledger must describe THIS run,
-    # not an accumulation of prior ones
-    if os.path.exists(events_path):
-        os.remove(events_path)
+    # not an accumulation of prior ones — including any size-rotated
+    # family members a rotation-enabled earlier run left behind (the
+    # ledger reads the whole family)
+    from pystella_tpu.obs.events import rotated_family
+    for member in rotated_family(events_path):
+        if os.path.exists(member):
+            os.remove(member)
     obs.configure(events_path)
 
     # persistent compilation cache: --cache-dir > an EXPLICITLY set
@@ -1321,6 +1336,45 @@ def run_smoke(argv=None):
             traceback.print_exc()
     elif not args.no_remesh:
         hb("smoke: <8 devices — skipping the remesh drill")
+
+    # scenario-service payload: the seeded loadgen mix through a live
+    # ScenarioService (pystella_tpu.service) — warm-pool admissions
+    # whose leases record ZERO backend compiles (the compile-ledger
+    # proof of dispatch-never-compile), one forced cold signature
+    # queued behind its build, one quota rejection, and one forced
+    # preemption (priority-3 arrival mid-lease -> drain -> durable
+    # checkpoint -> requeue) whose resumed members are re-verified
+    # bit-consistent against an uninterrupted replay. Every decision
+    # lands in the event log; the report's `service` section and the
+    # gate's SLO verdicts (queue-p95, warm TTFS, fingerprint refusal)
+    # derive from exactly this record — the smoke e2e
+    # (tests/test_gate.py) pins the whole chain.
+    if not args.no_service:
+        try:
+            import shutil
+            from pystella_tpu.service import loadgen as service_loadgen
+            svc_ck = os.path.join(args.out, "service_ckpt")
+            shutil.rmtree(svc_ck, ignore_errors=True)
+            svc = service_loadgen.run(svc_ck, seed=11,
+                                      label="smoke-service")
+            hb(f"smoke: service {svc['completed']}/{svc['requests']} "
+               f"request(s) completed over {svc['leases']} lease(s) "
+               f"({svc['warm_admissions']} warm / "
+               f"{svc['cold_admissions']} cold admission(s), "
+               f"{sum(svc['rejected'].values())} rejected, "
+               f"{svc['preemptions']} preemption(s), bit-consistent "
+               f"resume={svc['preempt_bitexact']})")
+            if not (svc["preempt_bitexact"]
+                    and svc["preemptions"] >= 1
+                    and svc["lease_failures"] == 0):
+                obs.emit("smoke_service_failed",
+                         preemptions=svc["preemptions"],
+                         bitexact=svc["preempt_bitexact"],
+                         lease_failures=svc["lease_failures"])
+        except Exception as e:  # noqa: BLE001 — record, never kill smoke
+            hb(f"smoke: service payload failed: "
+               f"{type(e).__name__}: {e}")
+            traceback.print_exc()
 
     # AOT warm-start leg: export the very step program this run timed,
     # reload the artifact, and pin the loaded program bit-exact against

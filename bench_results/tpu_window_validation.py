@@ -48,7 +48,16 @@ costs one leg, not the window):
    session) — the number the spectral tier exists to beat — plus the
    ``fft`` ledger section's per-stage/transpose split from a profiler
    capture of the calls.
-8. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
+8. ``service``      — PR 12: the scenario-service leg. The seeded
+   loadgen mix (``pystella_tpu.service.loadgen``) against a warm pool
+   armed for a 512³ signature on the held device: sustained
+   mixed-tenant priority traffic, one forced cold signature, one
+   forced preemption. Records the on-hardware queue-latency p95, the
+   warm time-to-first-step p50 (the dispatch-never-compile contract —
+   warm leases must record zero backend compiles), and the preemption
+   MTTR (``service_preempted`` to the first resumed re-dispatch),
+   which CPU rehearsal cannot price.
+9. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
    wires a FRESH ``PYSTELLA_COMPILE_CACHE_DIR``, builds the 512³
    multigrid + preheat step programs cold (recording
    time-to-first-step and the trace/compile split), and AOT-exports
@@ -454,6 +463,77 @@ def worker_spectral(dry_run):
     return rc
 
 
+def worker_service(dry_run):
+    """Scenario-service leg: the loadgen mix against a warm pool armed
+    for a hardware-scale signature — on-hardware queue-p95, warm TTFS,
+    and preemption MTTR (drain -> durable checkpoint -> resumed
+    re-dispatch), with the warm path's zero-backend-compile contract
+    checked from the same run's compile ledger."""
+    backend, ndev, dial_s = _dial(dry_run)
+    sys.path.insert(0, REPO)
+    from pystella_tpu import obs
+    from pystella_tpu.obs import events as obs_events
+    from pystella_tpu.obs.ledger import PerfLedger
+    from pystella_tpu.service import loadgen
+
+    events_path = os.path.join(OUT, "tpu_window_events.jsonl")
+    obs.configure(events_path)
+    obs.ensure_compilation_cache(
+        os.path.join(OUT, "tpu_window_xla_cache"))
+    obs.emit("run_start", mode="tpu-window-service")
+    # hardware: the 512^3-signature pool the ROADMAP names (2 members
+    # of a 2-field 512^3 state ~ 2 GiB of HBM, batched on the held
+    # chip); dry-run: the tier-1-sized mix
+    grid = 16 if dry_run else 512
+    slots = 4 if dry_run else 2
+    ck_dir = os.path.join(OUT, "tpu_window_service_ckpt")
+    import shutil
+    shutil.rmtree(ck_dir, ignore_errors=True)
+    t0 = time.perf_counter()
+    stats = loadgen.run(ck_dir, seed=17, slots=slots, grid=grid,
+                        cold_grid=12 if dry_run else 256,
+                        label=f"window-service-{grid}^3")
+    wall_s = time.perf_counter() - t0
+    led = PerfLedger.from_events(events_path,
+                                 label=f"service-{grid}^3")
+    sv = led.service() or {}
+    # preemption MTTR: service_preempted -> first resumed re-dispatch
+    # (scoped to THIS run — the window event log accumulates legs)
+    evs = obs_events.read_events(events_path, include_rotated=True)
+    starts = [i for i, e in enumerate(evs) if e["kind"] == "run_start"]
+    if starts:
+        evs = evs[starts[-1]:]
+    preempt_ts = next((e["ts"] for e in evs
+                       if e["kind"] == "service_preempted"), None)
+    resume_ts = next((e["ts"] for e in evs
+                      if e["kind"] == "service_dispatch"
+                      and e["data"].get("resumed")
+                      and (preempt_ts is None
+                           or e["ts"] >= preempt_ts)), None)
+    mttr = (resume_ts - preempt_ts
+            if preempt_ts is not None and resume_ts is not None
+            else None)
+    record("service", backend=backend, ndevices=ndev, grid=grid,
+           slots=slots, dial_s=round(dial_s, 2),
+           wall_s=round(wall_s, 2),
+           completed=stats.get("completed"),
+           requests=stats.get("requests"),
+           preemptions=stats.get("preemptions"),
+           preempt_bitexact=stats.get("preempt_bitexact"),
+           preempt_mttr_s=(round(mttr, 4) if mttr is not None
+                           else None),
+           queue_p95_s=((sv.get("queue_latency_s") or {})
+                        .get("overall") or {}).get("p95_s"),
+           warm_ttfs_p50_s=((sv.get("ttfs_s") or {})
+                            .get("warm") or {}).get("p50_s"),
+           warm_lease_backend_compiles=sv.get(
+               "warm_lease_backend_compiles"))
+    ok = (stats.get("preempt_bitexact") is True
+          and stats.get("lease_failures") == 0
+          and not sv.get("warm_lease_backend_compiles"))
+    return 0 if ok else 1
+
+
 def worker_cold_start(dry_run, phase):
     """phase='cold': fresh cache, build + time everything, probe
     donation safety, export AOT artifacts. phase='warm': re-dial
@@ -557,7 +637,7 @@ def main():
     p = argparse.ArgumentParser(prog="tpu_window_validation.py")
     p.add_argument("--legs", default="perf_trace,overlap,lint_tpu,"
                                      "ensemble,elastic,remesh,"
-                                     "spectral,cold_start",
+                                     "spectral,service,cold_start",
                    help="comma-separated legs, priority order")
     p.add_argument("--dry-run", action="store_true",
                    help="CPU + tiny grids: rehearse the plumbing")
@@ -574,7 +654,8 @@ def main():
               "ensemble": worker_ensemble,
               "elastic": worker_elastic,
               "remesh": worker_remesh,
-              "spectral": worker_spectral}.get(args.worker)
+              "spectral": worker_spectral,
+              "service": worker_service}.get(args.worker)
         if fn is not None:
             return fn(args.dry_run)
         if args.worker == "cold_start":

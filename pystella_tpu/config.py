@@ -129,6 +129,13 @@ register("PYSTELLA_EVENT_LOG", default=None, kind="path",
          help="JSONL run-event log path picked up by obs.events.get_log() "
               "when no explicit obs.configure() call was made; unset "
               "disables implicit event logging")
+register("PYSTELLA_EVENT_ROTATE_MB", default=None, kind="float",
+         help="size-triggered event-log rollover in MiB: when the live "
+              "JSONL file reaches this size, obs.events.EventLog "
+              "renames it to <stem>.<n>.jsonl and opens a fresh file, "
+              "so a persistent server cannot grow one unbounded log; "
+              "ledger ingestion reads the whole rotated family; unset "
+              "disables rotation")
 register("PYSTELLA_HALO_OVERLAP", default="auto", kind="bool",
          help="halo-exchange/compute overlap policy for sharded stencils: "
               "1/0 force on/off, unset/'auto' enables exactly when the "
@@ -207,6 +214,36 @@ register("PYSTELLA_FAULT_DEVICE_SUBSET_PERSIST", default="1", kind="bool",
               "lost, and only a re-meshed program that no longer "
               "touches them replays through cleanly; 0 makes it a "
               "one-shot transient like the other fault kinds")
+register("PYSTELLA_SERVICE_SLOTS", default="4", kind="int",
+         help="batch slots per scenario-service lease "
+              "(service.ScenarioService): each scheduler dispatch "
+              "leases up to this many shape-compatible requests to one "
+              "batched EnsembleStepper program")
+register("PYSTELLA_SERVICE_CHUNK", default="2", kind="int",
+         help="steps per batched dispatch inside a scenario-service "
+              "lease; preemption and checkpointing happen at chunk "
+              "boundaries, so this is also the preemption-latency "
+              "granularity")
+register("PYSTELLA_SERVICE_COLD_POLICY", default="compile",
+         help="admission policy for a request whose (model, lattice, "
+              "mesh) signature has no warm-pool entry "
+              "(service.AdmissionController): 'compile' admits it "
+              "queued behind the build+compile of a fresh pool entry "
+              "(its time-to-first-step then pays the compile), "
+              "'reject' refuses it with a typed ColdSignature verdict")
+register("PYSTELLA_SERVICE_QUOTA", default="64", kind="int",
+         help="per-tenant admission quota of the scenario service's "
+              "fair-share scheduler: submissions beyond this many "
+              "queued requests for one tenant are rejected "
+              "(service_reject event, reason 'quota') instead of "
+              "letting one tenant starve the others")
+register("PYSTELLA_SERVICE_PREEMPT", default="1", kind="bool",
+         help="priority preemption in the scenario service: 1 "
+              "(default) lets a pending request of a strictly higher "
+              "priority class preempt a running lease at the next "
+              "chunk boundary (drain -> durable checkpoint -> "
+              "requeue, no work lost); 0 runs every lease to "
+              "completion")
 register("PYSTELLA_FFT_SCHEME", default="auto",
          help="distributed-FFT scheme the planner (fourier.plan."
               "make_dft) and the spectra/projector/Poisson consumers "

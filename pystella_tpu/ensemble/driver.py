@@ -117,11 +117,15 @@ class Scenario:
 
 
 class _Job:
-    __slots__ = ("scenario", "seed")
+    __slots__ = ("scenario", "seed", "resume")
 
-    def __init__(self, scenario, seed):
+    def __init__(self, scenario, seed, resume=None):
         self.scenario = scenario
         self.seed = int(seed)
+        #: ``(state, step, t, params)`` for a job re-entering with a
+        #: restored trajectory (a preempted member) instead of a fresh
+        #: sampler draw — see :meth:`EnsembleDriver.requeue`
+        self.resume = resume
 
 
 class _Slot:
@@ -175,12 +179,24 @@ class EnsembleDriver:
         forwarded to :class:`~pystella_tpu.ensemble.EnsembleMonitor`.
     :arg emit_steps: per-chunk ``ensemble_health`` events (summary
         counts only).
+    :arg preempt: optional ``preempt(chunk_index) -> bool`` polled
+        after every batched dispatch; returning true DRAINS the run at
+        that chunk boundary — pending health matrices are converted,
+        the batch is synced, and every still-active member leaves as a
+        requeue record (scenario, seed, host state, steps done, t,
+        parameter draw) in the run output's ``preempted`` list, with
+        unstarted jobs in ``pending``. :meth:`requeue` is the matching
+        re-entry: a drained member resumes its OWN trajectory (bit-
+        consistent with an uninterrupted run) instead of resampling.
+        This is the scenario service's preemption hook
+        (:mod:`pystella_tpu.service`).
     """
 
     def __init__(self, size=None, chunk=4, decomp=None, via="auto",
                  donate=False, every=1, forensics=None, resample=None,
                  max_evictions=None, max_abs=None, invariant_bounds=None,
-                 history=64, emit_steps=False, label="ensemble"):
+                 history=64, emit_steps=False, label="ensemble",
+                 preempt=None):
         if size is None:
             size = _config.get_int("PYSTELLA_ENSEMBLE_SIZE")
         self.size = int(size)
@@ -201,6 +217,7 @@ class EnsembleDriver:
         self.history = int(history)
         self.emit_steps = bool(emit_steps)
         self.label = str(label)
+        self.preempt = preempt
         self._queue = []          # FIFO of _Job, submit order preserved
         self._next_seed = {}      # scenario name -> next resample seed
         self._predrawn = {}       # (id(scenario), seed) -> (state, params)
@@ -222,6 +239,29 @@ class EnsembleDriver:
         self._next_seed[scenario.name] = s + 1
         return s
 
+    def requeue(self, scenario, state, step, seed=0, params=None,
+                t=None):
+        """Re-enter a preempted member: the job re-joins the queue
+        carrying its RESTORED state and completed step count, so its
+        slot resumes the same trajectory instead of resampling from
+        scratch (the only re-entry path before this was a fresh draw).
+        ``state`` is one member's state pytree (host or device arrays);
+        ``step`` is the number of steps already taken (the member
+        retires after ``scenario.nsteps - step`` more); ``params`` is
+        the member's original parameter draw; ``t`` overrides the
+        resume time (default ``scenario.t0 + step * member_dt``). A
+        requeued member's trajectory is bit-consistent with its
+        uninterrupted run — the batched per-member bodies are
+        lane-independent, so neither the preemption boundary nor the
+        co-members of the resumed batch change its arithmetic."""
+        job = _Job(scenario, seed,
+                   resume={"state": state, "step": int(step),
+                           "t": t, "params": dict(params or {})})
+        self._queue.append(job)
+        nxt = self._next_seed.get(scenario.name, 0)
+        self._next_seed[scenario.name] = max(nxt, int(seed) + 1)
+        return self
+
     # -- grouping -----------------------------------------------------------
 
     def _group_jobs(self):
@@ -238,16 +278,26 @@ class EnsembleDriver:
         self._predrawn = {}  # (id(scenario), seed) -> (state, params)
         for job in self._queue:
             sc = job.scenario
-            ent = by_scenario.get(id(sc))
-            if ent is None:
-                state, params = sc.sample(job.seed)
-                ent = (_state_signature(state),
-                       tuple(sorted(params or {})),
-                       (state, dict(params or {})))
-                by_scenario[id(sc)] = ent
-                # the fill/refill path reuses this draw for the same
-                # job instead of sampling it a second time
-                self._predrawn[(id(sc), job.seed)] = ent[2]
+            if job.resume is not None:
+                # a requeued member carries its own restored state: its
+                # signature comes from THAT, not a sampler draw (and it
+                # groups with fresh jobs of the same shape — one
+                # batched program serves both)
+                ent = (_state_signature(job.resume["state"]),
+                       tuple(sorted(job.resume["params"])),
+                       (job.resume["state"],
+                        dict(job.resume["params"])))
+            else:
+                ent = by_scenario.get(id(sc))
+                if ent is None:
+                    state, params = sc.sample(job.seed)
+                    ent = (_state_signature(state),
+                           tuple(sorted(params or {})),
+                           (state, dict(params or {})))
+                    by_scenario[id(sc)] = ent
+                    # the fill/refill path reuses this draw for the same
+                    # job instead of sampling it a second time
+                    self._predrawn[(id(sc), job.seed)] = ent[2]
             sig, param_names, template = ent
             key = (id(sc.stepper), sig, param_names)
             if key not in by_key:
@@ -258,21 +308,32 @@ class EnsembleDriver:
         self._queue = []
         return groups
 
-    def _sample(self, scenario, seed):
-        """One member draw, reusing the grouping pass's signature draw
-        when it was for this very (scenario, seed) job."""
-        pre = self._predrawn.pop((id(scenario), seed), None)
+    def _sample(self, job):
+        """One member's fill: a requeued job re-enters with its
+        restored state; a fresh job draws from the sampler (reusing the
+        grouping pass's signature draw when it was for this very
+        (scenario, seed) job)."""
+        if job.resume is not None:
+            return job.resume["state"], dict(job.resume["params"])
+        pre = self._predrawn.pop((id(job.scenario), job.seed), None)
         if pre is not None:
             return pre[0], dict(pre[1])
-        return scenario.sample(seed)
+        return job.scenario.sample(job.seed)
 
     # -- the batch loop -----------------------------------------------------
 
     def run(self, on_finish=None):
         """Drain the queue. Returns ``{"results": [...], "evictions":
-        [...], "stats": {...}}``; ``on_finish(record, state)`` (if
-        given) receives each retired member's host state — the one
-        deliberate host sync, at retire time.
+        [...], "preempted": [...], "pending": [...], "stats": {...}}``;
+        ``on_finish(record, state)`` (if given) receives each retired
+        member's host state — the one deliberate host sync, at retire
+        time. With a ``preempt`` hook that fired, ``preempted`` holds
+        one requeue record per still-active member (pass each to
+        :meth:`requeue` to resume it later) and ``pending`` one record
+        per job that never started: ``{"scenario", "seed"}``, plus the
+        preserved resume payload (``state``/``step``/``t``/``params``)
+        when the job was itself a requeued member — pass those back
+        through :meth:`requeue`, the rest through :meth:`submit`.
 
         Raises :class:`~pystella_tpu.obs.sentinel.SimulationDiverged`
         only when a batch exhausts its eviction budget (the
@@ -283,11 +344,21 @@ class EnsembleDriver:
                      groups=[{"scenarios": sorted({j.scenario.name
                                                    for j in g["jobs"]}),
                               "jobs": len(g["jobs"])} for g in groups])
-        results, evictions = [], []
+        results, evictions, preempted, pending = [], [], [], []
         totals = {"member_steps": 0, "wall_s": 0.0, "chunks": 0,
                   "occupancy_sum": 0.0, "batches": len(groups)}
-        for g in groups:
-            self._run_group(g, results, evictions, totals, on_finish)
+        for gi, g in enumerate(groups):
+            drained = self._run_group(g, results, evictions, totals,
+                                      on_finish, preempted, pending)
+            if drained:
+                # the preempt hook fired: later groups never start —
+                # their jobs leave as pending, resubmittable as-is
+                # (the drained group's own unstarted jobs were already
+                # recorded by the drain)
+                pending += [self._pending_record(j)
+                            for rest in groups[gi + 1:]
+                            for j in rest["jobs"]]
+                break
         rate = (totals["member_steps"] / totals["wall_s"]
                 if totals["wall_s"] > 0 else None)
         occupancy = (totals["occupancy_sum"] / totals["chunks"]
@@ -302,9 +373,11 @@ class EnsembleDriver:
             "occupancy_mean": occupancy,
             "members_completed": len(results),
             "evictions": len(evictions),
+            "preempted": len(preempted),
         }
         _events.emit("ensemble_done", label=self.label, **stats)
         return {"results": results, "evictions": evictions,
+                "preempted": preempted, "pending": pending,
                 "stats": stats}
 
     def _make_monitor(self, sentinel):
@@ -314,7 +387,8 @@ class EnsembleDriver:
             emit_steps=self.emit_steps, label=self.label,
             forensics=self.forensics, max_evictions=self.max_evictions)
 
-    def _run_group(self, group, results, evictions, totals, on_finish):
+    def _run_group(self, group, results, evictions, totals, on_finish,
+                   preempted=None, pending=None):
         from pystella_tpu import obs
 
         jobs = list(group["jobs"])
@@ -339,7 +413,7 @@ class EnsembleDriver:
         for slot in slots:
             if jobs:
                 job = jobs.pop(0)
-                state, draw = self._sample(job.scenario, job.seed)
+                state, draw = self._sample(job)
                 self._arm(slot, job, draw, params, monitor)
                 member_states.append(state)
                 t_vec[slot.index] = slot.t
@@ -388,6 +462,17 @@ class EnsembleDriver:
             batch = self._retire_and_refill(
                 slots, jobs, batch, ens, params, t_vec, dt_vec, monitor,
                 chunk_index, results, on_finish, evictions)
+            if (self.preempt is not None
+                    and any(s.active for s in slots)
+                    and self.preempt(chunk_index)):
+                self._drain(slots, jobs, batch, ens, params, t_vec,
+                            monitor, chunk_index, evictions,
+                            preempted if preempted is not None else [],
+                            pending if pending is not None else [])
+                drained = True
+                break
+        else:
+            drained = False
         # end of group: convert matrices still inside the maturity lag;
         # late trips are honest evictions (recorded, slot already done)
         late = monitor.flush()
@@ -401,6 +486,66 @@ class EnsembleDriver:
         # full sync, at its natural end
         jax.block_until_ready(batch)
         totals["wall_s"] += time.perf_counter() - group_t0
+        return drained
+
+    def _drain(self, slots, jobs, batch, ens, params, t_vec, monitor,
+               chunk_index, evictions, preempted, pending):
+        """Preemption drain at a chunk boundary: convert the health
+        matrices still inside the maturity lag (a trip found here is an
+        honest eviction — a diverged trajectory must not be requeued as
+        good work), sync the batch, and capture every still-active
+        member as a requeue record. No work is lost: the captured state
+        is exactly the trajectory at ``steps_done`` steps, and
+        :meth:`requeue` re-enters it bit-consistently."""
+        late = monitor.flush()
+        for ev in late:
+            evictions.append(ev)
+            s = slots[ev.member]
+            if s.active:
+                # evicted at the drain: its trajectory is poisoned —
+                # record the eviction (done by the monitor) and do NOT
+                # requeue it; the drain never resamples (the batch is
+                # stopping, a fresh draw would be immediately drained
+                # at step 0)
+                s.active = False
+                monitor.mask_member(s.index)
+        jax.block_until_ready(batch)
+        for s in slots:
+            if not s.active:
+                continue
+            rec = {
+                "scenario": s.job.scenario,
+                "seed": s.job.seed,
+                "state": ens.take_member(batch, s.index),
+                "step": s.steps_done,
+                "t": float(t_vec[s.index]),
+                "params": {n: float(params[n][s.index])
+                           for n in params},
+            }
+            preempted.append(rec)
+            _events.emit("member_preempted", label=self.label,
+                         member=s.index,
+                         scenario=s.job.scenario.name, seed=s.job.seed,
+                         step=s.steps_done)
+            s.active = False
+            monitor.mask_member(s.index)
+        pending += [self._pending_record(j) for j in jobs]
+        del jobs[:]
+
+    @staticmethod
+    def _pending_record(job):
+        """An unstarted job as a resubmittable record. A job that was
+        itself REQUEUED (it carries a restored trajectory) keeps its
+        resume payload — dropping it would silently restart the member
+        from step 0, losing the work the earlier drain preserved;
+        resubmit such a record with :meth:`requeue`, plain ones with
+        :meth:`submit`."""
+        rec = {"scenario": job.scenario, "seed": job.seed}
+        if job.resume is not None:
+            rec.update(state=job.resume["state"],
+                       step=job.resume["step"], t=job.resume["t"],
+                       params=dict(job.resume["params"]))
+        return rec
 
     def _arm(self, slot, job, draw, params, monitor):
         sc = job.scenario
@@ -408,6 +553,13 @@ class EnsembleDriver:
         slot.steps_done = 0
         slot.t = sc.t0
         slot.dt = sc.member_dt(job.seed)
+        if job.resume is not None:
+            # a requeued member picks its trajectory back up where the
+            # drain left it: step budget and clock both resume
+            slot.steps_done = int(job.resume["step"])
+            slot.t = (float(job.resume["t"])
+                      if job.resume["t"] is not None
+                      else sc.t0 + slot.steps_done * slot.dt)
         slot.active = True
         for n in params:
             params[n][slot.index] = float(draw.get(n, 0.0))
@@ -416,7 +568,9 @@ class EnsembleDriver:
                                    "dt": slot.dt},
                            scenario=sc.name)
         _events.emit("member_started", label=self.label,
-                     member=slot.index, scenario=sc.name, seed=job.seed)
+                     member=slot.index, scenario=sc.name, seed=job.seed,
+                     resumed_from=(slot.steps_done
+                                   if job.resume is not None else None))
 
     def _handle_evictions(self, new_ev, slots, batch, ens, params,
                           t_vec, dt_vec, monitor, chunk_index,
@@ -484,7 +638,7 @@ class EnsembleDriver:
                 on_finish(record, ens.take_member(batch, slot.index))
             if jobs:
                 nxt = jobs.pop(0)
-                state, draw = self._sample(nxt.scenario, nxt.seed)
+                state, draw = self._sample(nxt)
                 batch = ens.write_member(batch, slot.index, state)
                 self._arm(slot, nxt, draw, params, monitor)
                 t_vec[slot.index] = slot.t

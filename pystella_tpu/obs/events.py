@@ -49,9 +49,34 @@ import threading
 import time
 
 __all__ = ["EventLog", "configure", "emit", "get_log", "read_events",
-           "SCHEMA_VERSION"]
+           "rotated_family", "SCHEMA_VERSION"]
 
 SCHEMA_VERSION = 1
+
+
+def _rotated_name(path, index):
+    """``run_events.jsonl`` -> ``run_events.<index>.jsonl``."""
+    root, ext = os.path.splitext(path)
+    return f"{root}.{index}{ext or '.jsonl'}"
+
+
+def rotated_family(path):
+    """Every file of a rotated event log, OLDEST FIRST and the live
+    file last: ``[<stem>.0.jsonl, <stem>.1.jsonl, ..., <path>]``
+    (missing members are skipped; an un-rotated log is just
+    ``[path]``). This is the read-side contract of ``rotate_bytes=``:
+    a consumer that wants the whole record reads the family in this
+    order and sees one continuous stream."""
+    family = []
+    index = 0
+    while True:
+        rotated = _rotated_name(path, index)
+        if not os.path.exists(rotated):
+            break
+        family.append(rotated)
+        index += 1
+    family.append(path)
+    return family
 
 
 def _host_id():
@@ -96,22 +121,91 @@ class EventLog:
     :arg path: output file (parent directories are created), or ``None``
         for a disabled sink whose :meth:`emit` is a cheap no-op.
     :arg host: override the host id (default: lazy jax process index).
+    :arg rotate_bytes: size-triggered rollover for long-lived processes
+        (the scenario service runs for days — one unbounded JSONL is an
+        operational hazard): when the live file reaches this size after
+        a write, it is renamed to the next ``<stem>.<n>.jsonl`` member
+        of the rotated family (:func:`rotated_family`) and a fresh file
+        is opened at ``path``. Default: the registered
+        ``PYSTELLA_EVENT_ROTATE_MB`` (unset disables). Rotation never
+        splits a line — whole events only.
 
     Thread-safe; every line is flushed on write so concurrently-appending
     processes (orchestrator + payload) interleave whole lines.
     """
 
-    def __init__(self, path=None, host=None):
+    def __init__(self, path=None, host=None, rotate_bytes=None):
         self.path = None if path is None else os.path.abspath(str(path))
         self._host = host
         self._lock = threading.Lock()
         self._file = None
         self._warned = False
+        if rotate_bytes is None:
+            # direct read (not config.getenv): this module must stay
+            # loadable BY FILE in a jax-free supervisor, where the
+            # package import is unavailable
+            mb = os.environ.get(
+                "PYSTELLA_EVENT_ROTATE_MB")  # env-registry: PYSTELLA_EVENT_ROTATE_MB
+            if mb:
+                try:
+                    rotate_bytes = float(mb) * 2**20
+                except ValueError:
+                    rotate_bytes = None
+        self.rotate_bytes = (int(rotate_bytes)
+                             if rotate_bytes else None)
         if self.path is not None:
             parent = os.path.dirname(self.path)
             if parent:
                 os.makedirs(parent, exist_ok=True)
             self._file = open(self.path, "a")
+
+    def _maybe_rotate(self):
+        """Roll the live file over once it reached ``rotate_bytes``
+        (caller holds the lock; the just-written line stays whole in
+        the rotated member). Rotation failures degrade to
+        keep-appending — telemetry must never kill the run.
+
+        Concurrent appenders (the orchestrator + payload pattern) are
+        tolerated via an inode check: when ANOTHER process already
+        rotated the live file out from under this one, this writer
+        re-points at the fresh live file instead of renaming it away —
+        otherwise two writers would leapfrog-rotate each other's fresh
+        files. Lines the laggard wrote into the rotated member before
+        noticing remain there (whole, just earlier in the family), so
+        the family read stays lossless; single-writer logs (the normal
+        service deployment) rotate exactly at the threshold."""
+        try:
+            st_fd = os.fstat(self._file.fileno())
+            try:
+                st_path = os.stat(self.path)
+            except FileNotFoundError:
+                st_path = None
+            if st_path is None or (st_path.st_ino, st_path.st_dev) \
+                    != (st_fd.st_ino, st_fd.st_dev):
+                # someone else rotated (or removed) the live file:
+                # follow them instead of rotating their fresh file
+                self._file.close()
+                self._file = open(self.path, "a")
+                return
+            if st_fd.st_size < self.rotate_bytes:
+                return
+            index = 0
+            while os.path.exists(_rotated_name(self.path, index)):
+                index += 1
+            self._file.close()
+            os.replace(self.path, _rotated_name(self.path, index))
+            self._file = open(self.path, "a")
+        except OSError as e:
+            if not self._warned:
+                self._warned = True
+                print(f"pystella_tpu.obs: event log rotation failed "
+                      f"({e}); continuing on the live file",
+                      file=sys.stderr)
+            if self._file is None or self._file.closed:
+                try:
+                    self._file = open(self.path, "a")
+                except OSError:
+                    self._file = None
 
     @property
     def enabled(self):
@@ -144,6 +238,8 @@ class EventLog:
                           f"({e}); further events may be lost",
                           file=sys.stderr)
                 return None
+            if self.rotate_bytes:
+                self._maybe_rotate()
         return rec
 
     def close(self):
@@ -186,11 +282,12 @@ def get_log():
     return _default
 
 
-def configure(path=None, host=None):
+def configure(path=None, host=None, rotate_bytes=None):
     """(Re)point the process-default event log at ``path`` (``None``
     disables). Returns the new log; the previous one is closed."""
     global _default
-    old, _default = _default, EventLog(path, host=host)
+    old, _default = _default, EventLog(path, host=host,
+                                       rotate_bytes=rotate_bytes)
     if old is not None:
         old.close()
     return _default
@@ -201,22 +298,27 @@ def emit(kind, step=None, **data):
     return get_log().emit(kind, step=step, **data)
 
 
-def read_events(path, kind=None):
+def read_events(path, kind=None, include_rotated=False):
     """Load events from a JSONL file (newest last). Torn trailing lines
     from a killed writer are skipped, like ``bench.py``'s line cache.
-    ``kind`` optionally filters."""
+    ``kind`` optionally filters. ``include_rotated=True`` reads the
+    whole rotated family (:func:`rotated_family`) oldest-first, so a
+    size-rotated long-lived log reads as one continuous record — the
+    ledger ingests event logs this way."""
     out = []
-    try:
-        with open(path) as f:
-            for ln in f:
-                if not ln.strip():
-                    continue
-                try:
-                    rec = json.loads(ln)
-                except ValueError:
-                    continue  # torn line
-                if kind is None or rec.get("kind") == kind:
-                    out.append(rec)
-    except OSError:
-        return []
+    paths = rotated_family(path) if include_rotated else [path]
+    for member in paths:
+        try:
+            with open(member) as f:
+                for ln in f:
+                    if not ln.strip():
+                        continue
+                    try:
+                        rec = json.loads(ln)
+                    except ValueError:
+                        continue  # torn line
+                    if kind is None or rec.get("kind") == kind:
+                        out.append(rec)
+        except OSError:
+            continue
     return out

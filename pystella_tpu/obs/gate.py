@@ -19,16 +19,22 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       (member-steps/s) drops more than ``ensemble_threshold_pct`` below
       the baseline's — or a SPECTRAL regression: the ``fft`` section's
       spectra p50 ms/call exceeds the baseline's by more than
-      ``fft_threshold_pct``
+      ``fft_threshold_pct`` — or a SERVICE SLO regression: the
+      ``service`` section's queue-latency p95 (or warm-lease
+      time-to-first-step p50) exceeds the baseline's by both the
+      configured factor and floor
 2     invalid evidence: the contamination detector flagged the run
       (outlier burst / bimodal step times — the round-5 concurrent-probe
       signature), the report has no step samples, the run DIVERGED (a
       sentinel trip in the ``numerics`` section — broken step times
       prove nothing), the report CLAIMS warm start over AOT artifacts
-      whose fingerprints mismatch the live compiler stack, the report
-      claims fewer incidents than its ``resilience`` event record
-      carries (a clean headline over a degraded fleet), or baseline
-      and current were measured on different hardware. Exception: a
+      whose fingerprints mismatch the live compiler stack, the
+      ``service`` section claims warm ADMISSIONS over mismatched
+      fingerprints (the leases did not dispatch the programs the
+      admission contract names), the report claims fewer incidents
+      than its ``resilience`` event record carries (a clean headline
+      over a degraded fleet), or baseline and current were measured on
+      different hardware. Exception: a
       run that recorded AND recovered REAL (non-harness-injected)
       incidents (``resilience`` section,
       :mod:`pystella_tpu.resilience`) keeps its evidence —
@@ -206,7 +212,11 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     cold_start_factor=1.5, cold_start_floor=5.0,
                     check_ensemble=True, ensemble_threshold_pct=20.0,
                     check_resilience=True,
-                    check_fft=True, fft_threshold_pct=25.0):
+                    check_fft=True, fft_threshold_pct=25.0,
+                    check_service=True, service_queue_factor=2.5,
+                    service_queue_floor_s=0.5,
+                    service_ttfs_factor=2.5,
+                    service_ttfs_floor_s=1.0):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -431,6 +441,39 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                 f"taken: {a.get('label')!r} "
                 f"({a.get('reason') or a.get('fingerprint')})")
 
+    if check_service:
+        csv = current.get("service") or {}
+        if csv.get("warm_claimed"):
+            bad = [a for a in csv.get("warm_admissions") or []
+                   if a.get("fingerprint_ok") is False]
+            if bad:
+                # the report says requests were admitted WARM — served
+                # from the ready pool, latency = dispatch — over
+                # program fingerprints that do not match the live
+                # compiler stack: whatever those leases dispatched, it
+                # was not the programs the admission contract names;
+                # neither pass nor fail
+                verdict.update(ok=False, exit_code=2)
+                for a in bad[:5]:
+                    verdict["reasons"].append(
+                        "invalid_evidence: report claims warm "
+                        "admission over a mismatched fingerprint: "
+                        f"request {a.get('id')} "
+                        f"({a.get('fingerprint')})")
+                return verdict
+        if csv.get("warm_lease_backend_compiles"):
+            # an honest-but-broken warm path: the fingerprints match
+            # but the compile ledger recorded backend compiles inside
+            # warm leases — the dispatch-never-compile contract
+            # regressed; warn loudly (the TTFS comparison below is
+            # what fails CI when it costs latency)
+            verdict["warnings"].append(
+                "service: "
+                f"{csv['warm_lease_backend_compiles']} backend "
+                "compile(s) recorded inside warm leases — the warm "
+                "path is supposed to be pure dispatch; check the "
+                "service section's lease records")
+
     cur_num = current.get("numerics") or {}
     if check_numerics and cur_num.get("diverged"):
         # a diverged run's step times measure a broken computation;
@@ -577,6 +620,12 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     if check_fft:
         _compare_fft(verdict, baseline, current,
                      threshold_pct=fft_threshold_pct)
+    if check_service:
+        _compare_service(verdict, baseline, current,
+                         queue_factor=service_queue_factor,
+                         queue_floor_s=service_queue_floor_s,
+                         ttfs_factor=service_ttfs_factor,
+                         ttfs_floor_s=service_ttfs_floor_s)
     if check_resilience and (baseline or {}).get("resilience") \
             and not current.get("resilience"):
         verdict["warnings"].append(
@@ -642,6 +691,76 @@ def _compare_fft(verdict, baseline, current, threshold_pct=25.0):
         verdict["warnings"].append(
             f"fft improvement: spectra p50 {-slow_pct:.1f}% below "
             "baseline — consider refreshing the baseline")
+
+
+def _compare_service(verdict, baseline, current, queue_factor=2.5,
+                     queue_floor_s=0.5, ttfs_factor=2.5,
+                     ttfs_floor_s=1.0):
+    """Scenario-service SLO comparison (mutates ``verdict`` in place):
+    two production latency metrics from the ``service`` report section
+    (:mod:`pystella_tpu.service`), each gated by a relative factor AND
+    an absolute floor — service latencies on a small smoke mix are
+    single-sample-scale and jitter with host load, so a pure ratio
+    would flap:
+
+    - **queue-p95**: the overall p95 queue latency (submit ->
+      dispatch). A regression means the scheduler is falling behind
+      the offered load — the user-facing SLO.
+    - **warm TTFS**: the warm leases' median time-to-first-step. The
+      warm pool's whole contract is dispatch-never-compile; warm TTFS
+      drifting toward cold TTFS means requests are paying compiles
+      again.
+
+    Coverage loss (baseline had a ``service`` section, current does
+    not) degrades to a warning. The warm-over-mismatched-fingerprints
+    refusal runs earlier, before any baseline is consulted."""
+    bsv = (baseline or {}).get("service") or {}
+    csv = current.get("service") or {}
+    if bsv and not csv:
+        verdict["warnings"].append(
+            "service: baseline carried a service section but the "
+            "current run has none — queue/TTFS SLO coverage was lost")
+        return
+    if not bsv or not csv:
+        return
+    compared = {}
+
+    def _leg(name, b, c, factor, floor_s, what):
+        if not isinstance(b, (int, float)) or b < 0 \
+                or not isinstance(c, (int, float)):
+            if isinstance(b, (int, float)) and c is None:
+                verdict["warnings"].append(
+                    f"service: baseline tracked {what} but the "
+                    "current run's service section carries none — "
+                    "SLO coverage was lost")
+            return
+        compared[name] = {"baseline_s": b, "current_s": c,
+                          "factor": factor, "floor_s": floor_s}
+        if c > b * factor and c - b > floor_s:
+            verdict.update(ok=False,
+                           exit_code=max(verdict["exit_code"], 1))
+            verdict["reasons"].append(
+                f"service SLO regression: {what} {c:.3g} s vs "
+                f"baseline {b:.3g} s (allowed factor {factor:g}, "
+                f"floor {floor_s:g} s) — see the report's service "
+                "section")
+        elif b > c * factor and b - c > floor_s:
+            verdict["warnings"].append(
+                f"service improvement: {what} {c:.3g} s vs baseline "
+                f"{b:.3g} s — consider refreshing the baseline")
+
+    _leg("queue_p95",
+         ((bsv.get("queue_latency_s") or {}).get("overall")
+          or {}).get("p95_s"),
+         ((csv.get("queue_latency_s") or {}).get("overall")
+          or {}).get("p95_s"),
+         queue_factor, queue_floor_s, "queue-latency p95")
+    _leg("warm_ttfs",
+         ((bsv.get("ttfs_s") or {}).get("warm") or {}).get("p50_s"),
+         ((csv.get("ttfs_s") or {}).get("warm") or {}).get("p50_s"),
+         ttfs_factor, ttfs_floor_s, "warm time-to-first-step p50")
+    if compared:
+        verdict["service"] = compared
 
 
 def _compare_ensemble(verdict, baseline, current, threshold_pct=20.0):
@@ -861,6 +980,24 @@ def main(argv=None):
     p.add_argument("--no-fft", action="store_true",
                    help="skip the spectral-tier (fft section) "
                         "spectra-throughput check")
+    p.add_argument("--service-queue-factor", type=float, default=2.5,
+                   help="service: allowed multiple of the baseline's "
+                        "queue-latency p95 before the gate fails "
+                        "(default 2.5)")
+    p.add_argument("--service-queue-floor", type=float, default=0.5,
+                   help="service: absolute seconds a queue-p95 "
+                        "regression must also exceed (default 0.5)")
+    p.add_argument("--service-ttfs-factor", type=float, default=2.5,
+                   help="service: allowed multiple of the baseline's "
+                        "warm time-to-first-step p50 before the gate "
+                        "fails (default 2.5)")
+    p.add_argument("--service-ttfs-floor", type=float, default=1.0,
+                   help="service: absolute seconds a warm-TTFS "
+                        "regression must also exceed (default 1)")
+    p.add_argument("--no-service", action="store_true",
+                   help="skip the scenario-service checks (queue-p95 / "
+                        "warm-TTFS SLO regressions, warm-admission-"
+                        "over-mismatched-fingerprints refusal)")
     p.add_argument("--no-resilience", action="store_true",
                    help="skip the resilience triage (degraded-fleet "
                         "annotation of regressions/contamination across "
@@ -919,7 +1056,12 @@ def main(argv=None):
         ensemble_threshold_pct=args.ensemble_threshold_pct,
         check_resilience=not args.no_resilience,
         check_fft=not args.no_fft,
-        fft_threshold_pct=args.fft_threshold_pct)
+        fft_threshold_pct=args.fft_threshold_pct,
+        check_service=not args.no_service,
+        service_queue_factor=args.service_queue_factor,
+        service_queue_floor_s=args.service_queue_floor,
+        service_ttfs_factor=args.service_ttfs_factor,
+        service_ttfs_floor_s=args.service_ttfs_floor)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

@@ -257,6 +257,15 @@ class PerfLedger:
         self.spectra_ms = []            # per-call spectra wall times
         #                                 (spectra_time events — drivers
         #                                 emit one per spectra output)
+        self.service_dispatches = []    # service_dispatch payloads
+        self.service_leases = []        # service_lease payloads
+        self.service_admits = []        # service_admit payloads
+        self.service_rejects = []       # service_reject payloads
+        self.service_preemptions = 0    # service_preempted events
+        self.service_results = []       # member_result payloads
+        self.service_done = {}          # last service_done payload
+        self.service_loadgen = {}       # last service_loadgen payload
+        self.service_lease_failures = 0  # service_lease_failed events
 
     # -- ingestion ---------------------------------------------------------
 
@@ -287,7 +296,11 @@ class PerfLedger:
         """
         led = cls(label=label, sites=sites)
         window_ms = []
-        all_events = _events.read_events(events_path)
+        # include_rotated: a size-rotated long-lived log (the scenario
+        # service's rotate_bytes=) ingests as one continuous stream —
+        # the latest-run scoping below then applies across the family
+        all_events = _events.read_events(events_path,
+                                         include_rotated=True)
         starts = [i for i, ev in enumerate(all_events)
                   if ev.get("kind") in ("run_start", "bench_run")]
         if starts:
@@ -411,6 +424,28 @@ class PerfLedger:
                 # spectra cost is a ledger-visible series, not a one-off
                 # microbenchmark
                 led.spectra_ms.append(float(data["ms"]))
+            elif kind == "service_dispatch":
+                # the scenario service's per-request dispatch record
+                # (queue latency, priority class, warm/cold tag) — the
+                # `service` section's queue-latency percentiles come
+                # from these
+                led.service_dispatches.append(data)
+            elif kind == "service_lease":
+                led.service_leases.append(data)
+            elif kind == "service_admit":
+                led.service_admits.append(data)
+            elif kind == "service_reject":
+                led.service_rejects.append(data)
+            elif kind == "service_preempted":
+                led.service_preemptions += 1
+            elif kind == "service_lease_failed":
+                led.service_lease_failures += 1
+            elif kind == "member_result":
+                led.service_results.append(data)
+            elif kind == "service_done":
+                led.service_done = data
+            elif kind == "service_loadgen":
+                led.service_loadgen = data
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -977,6 +1012,101 @@ class PerfLedger:
             "num_devices": ndev,
         }
 
+    def service(self):
+        """The scenario-service summary (:mod:`pystella_tpu.service`):
+        queue-latency percentiles per priority class (from the
+        per-request ``service_dispatch`` records), time-to-first-step
+        split warm/cold (from the lease records — the cold side pays
+        the build+compile, the warm side must stay pure dispatch),
+        tenant occupancy shares, preemption counts plus
+        work-lost-to-replay, rejection/eviction accounting, and the
+        warm-admission evidence the gate audits: every warm admission's
+        fingerprint status and the warm leases' backend-compile count
+        from the compile ledger (a warm lease that compiled broke the
+        dispatch-never-compile contract). ``None`` when the run carried
+        no service telemetry at all."""
+        if not (self.service_dispatches or self.service_leases
+                or self.service_admits or self.service_rejects
+                or self.service_results or self.service_done):
+            return None
+        by_class = {}
+        qlats = []
+        for d in self.service_dispatches:
+            q = d.get("queue_latency_s")
+            if not isinstance(q, (int, float)):
+                continue
+            qlats.append(float(q))
+            by_class.setdefault(str(d.get("priority")), []).append(
+                float(q))
+        ttfs = {"warm": [], "cold": []}
+        for rec in self.service_leases:
+            t = rec.get("ttfs_s")
+            if isinstance(t, (int, float)):
+                ttfs["warm" if rec.get("warm") else "cold"].append(
+                    float(t))
+        warm_admissions = [
+            {"id": a.get("id"), "fingerprint": a.get("fingerprint"),
+             "fingerprint_ok": a.get("fingerprint_ok")}
+            for a in self.service_admits if a.get("warm")]
+        warm_leases = [r for r in self.service_leases if r.get("warm")]
+        warm_compiles = sum(int(r.get("backend_compiles") or 0)
+                            for r in warm_leases)
+        rejects = {}
+        for r in self.service_rejects:
+            reason = str(r.get("reason"))
+            rejects[reason] = rejects.get(reason, 0) + 1
+        statuses = {}
+        for r in self.service_results:
+            s = str(r.get("status"))
+            statuses[s] = statuses.get(s, 0) + 1
+        tenant_steps = dict(self.service_done.get("tenant_steps") or {})
+        if not tenant_steps:
+            for rec in self.service_leases:
+                for tenant, steps in (rec.get("tenant_steps")
+                                      or {}).items():
+                    tenant_steps[tenant] = (tenant_steps.get(tenant, 0)
+                                            + int(steps))
+        total_steps = sum(tenant_steps.values())
+        replayed = self.service_done.get("replayed_member_steps")
+        if replayed is None:
+            replayed = sum(int(r.get("replayed_member_steps") or 0)
+                           for r in self.service_leases)
+        out = {
+            "requests": len({d.get("id")
+                             for d in self.service_dispatches}),
+            "admitted": len(self.service_admits),
+            "results": statuses,
+            "completed": statuses.get("completed", 0),
+            "diverged": statuses.get("diverged", 0),
+            "rejected": rejects,
+            "queue_latency_s": {
+                "overall": _lat_stats(qlats),
+                "by_priority": {cls: _lat_stats(v)
+                                for cls, v in sorted(by_class.items())},
+            },
+            "ttfs_s": {"warm": _lat_stats(ttfs["warm"]),
+                       "cold": _lat_stats(ttfs["cold"])},
+            "warm_claimed": bool(warm_admissions),
+            "warm_admissions": warm_admissions[:64],
+            "warm_leases": len(warm_leases),
+            "warm_lease_backend_compiles": warm_compiles,
+            "leases": len(self.service_leases),
+            "lease_failures": self.service_lease_failures,
+            "preemptions": self.service_preemptions,
+            "work_lost_to_replay_member_steps": int(replayed or 0),
+            "tenant_member_steps": tenant_steps,
+            "tenant_share": ({t: s / total_steps
+                              for t, s in tenant_steps.items()}
+                             if total_steps else {}),
+        }
+        if self.service_loadgen:
+            out["loadgen"] = {
+                k: self.service_loadgen.get(k)
+                for k in ("seed", "requests", "warm_admissions",
+                          "cold_admissions", "preempted_requests",
+                          "preempt_bitexact")}
+        return out
+
     def _degrading_plan(self):
         """The last remesh_plan that actually changed the mesh
         (``changed`` and ``feasible``), or ``None`` — transport-blip
@@ -1046,6 +1176,7 @@ class PerfLedger:
             "ensemble": self.ensemble(),
             "resilience": self.resilience(),
             "fft": self.fft(),
+            "service": self.service(),
             "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
@@ -1067,6 +1198,23 @@ class PerfLedger:
             f.write(render_markdown(rep))
         _events.emit("perf_report", path=json_path, label=self.label)
         return json_path
+
+
+def _lat_stats(samples_s):
+    """Latency-distribution summary in SECONDS (the service section's
+    queue-latency / TTFS fields; ``step_stats`` stays the millisecond
+    step-time shape): count, mean, p50/p90/p95, max."""
+    if not samples_s:
+        return {"count": 0}
+    s = sorted(float(x) for x in samples_s)
+    return {
+        "count": len(s),
+        "mean_s": sum(s) / len(s),
+        "p50_s": percentile(s, 50),
+        "p90_s": percentile(s, 90),
+        "p95_s": percentile(s, 95),
+        "max_s": s[-1],
+    }
 
 
 def _slope(xs, ys):
@@ -1365,6 +1513,74 @@ def render_markdown(rep):
             for d in deg[:4]:
                 lines.append(f"- **degraded** at step {d.get('step')}: "
                              f"{d.get('note')}")
+        lines.append("")
+    sv = rep.get("service")
+    if sv:
+        lines += ["## Service", ""]
+        ql = (sv.get("queue_latency_s") or {})
+        overall = ql.get("overall") or {}
+        lines.append(
+            f"- {_fmt(sv.get('requests'), '.0f', '0')} request(s) "
+            f"dispatched over {_fmt(sv.get('leases'), '.0f', '0')} "
+            f"lease(s): {_fmt(sv.get('completed'), '.0f', '0')} "
+            f"completed, {_fmt(sv.get('diverged'), '.0f', '0')} "
+            f"diverged, "
+            f"{_fmt(sum((sv.get('rejected') or {}).values()), '.0f', '0')}"
+            f" rejected"
+            + (f" ({', '.join(f'{k}: {v}' for k, v in sorted((sv.get('rejected') or {}).items()))})"
+               if sv.get("rejected") else ""))
+        lines.append(
+            f"- queue latency: p50 {_fmt(overall.get('p50_s'))} s, "
+            f"p95 {_fmt(overall.get('p95_s'))} s over "
+            f"{_fmt(overall.get('count'), '.0f', '0')} dispatch(es)")
+        for cls, row in sorted((ql.get("by_priority") or {}).items()):
+            lines.append(
+                f"  - class {cls}: p50 {_fmt(row.get('p50_s'))} s, "
+                f"p95 {_fmt(row.get('p95_s'))} s "
+                f"({row.get('count')} dispatch(es))")
+        tf = sv.get("ttfs_s") or {}
+        warm_t, cold_t = tf.get("warm") or {}, tf.get("cold") or {}
+        lines.append(
+            f"- time-to-first-step: warm p50 "
+            f"{_fmt(warm_t.get('p50_s'))} s "
+            f"({_fmt(warm_t.get('count'), '.0f', '0')} lease(s)), "
+            f"cold p50 {_fmt(cold_t.get('p50_s'))} s "
+            f"({_fmt(cold_t.get('count'), '.0f', '0')} lease(s))")
+        lines.append(
+            f"- warm path: {_fmt(sv.get('warm_leases'), '.0f', '0')} "
+            f"warm lease(s), "
+            f"{_fmt(sv.get('warm_lease_backend_compiles'), '.0f', '0')} "
+            "backend compile(s) on them (the contract is ZERO)"
+            + ("" if not sv.get("warm_lease_backend_compiles") else
+               " — **dispatch-never-compile violated**"))
+        bad_warm = [a for a in sv.get("warm_admissions") or []
+                    if a.get("fingerprint_ok") is False]
+        if bad_warm:
+            lines.append(
+                f"- **{len(bad_warm)} warm admission(s) over "
+                "mismatched fingerprints** — the gate refuses this "
+                "report")
+        lines.append(
+            f"- {_fmt(sv.get('preemptions'), '.0f', '0')} "
+            f"preemption(s), "
+            f"{_fmt(sv.get('work_lost_to_replay_member_steps'), '.0f', '0')}"
+            f" member-step(s) lost to replay, "
+            f"{_fmt(sv.get('lease_failures'), '.0f', '0')} lease "
+            "failure(s)")
+        shares = sv.get("tenant_share") or {}
+        if shares:
+            lines.append("- tenant occupancy: " + ", ".join(
+                f"{t} {_fmt(f, '.1%')}"
+                for t, f in sorted(shares.items())))
+        lg = sv.get("loadgen")
+        if lg:
+            lines.append(
+                f"- loadgen (seed {lg.get('seed')}): "
+                f"{_fmt(lg.get('requests'), '.0f', '0')} request(s), "
+                f"{_fmt(lg.get('warm_admissions'), '.0f', '0')} warm / "
+                f"{_fmt(lg.get('cold_admissions'), '.0f', '0')} cold "
+                "admission(s), preempted-resume bit-exact: "
+                f"{lg.get('preempt_bitexact')}")
         lines.append("")
     ff = rep.get("fft")
     if ff:

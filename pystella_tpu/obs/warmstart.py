@@ -28,16 +28,23 @@ CLI::
 
     python -m pystella_tpu.obs.warmstart export --out DIR [--target N]
     python -m pystella_tpu.obs.warmstart verify --dir DIR
+    python -m pystella_tpu.obs.warmstart list --dir DIR
+    python -m pystella_tpu.obs.warmstart gc --dir DIR [--dry-run]
 
-(both directories default to ``PYSTELLA_WARMSTART_DIR`` when set,
+(all directories default to ``PYSTELLA_WARMSTART_DIR`` when set,
 which is also the default store location for drivers — ``bench.py``'s
 warm-start leg persists and reloads its artifacts there)
 
 ``export`` builds the lint target registry's step programs (the same
 CPU-safe 8-device builds the IR audit lowers) and serializes each;
 ``verify`` checks every artifact in a directory against the live
-process's versions/flags. Exit codes: 0 ok, 1 mismatch/failure, 2 bad
-usage.
+process's versions/flags (exit 1 when any is stale); ``list``
+enumerates artifacts with fingerprint/version/match-status (always
+exit 0); ``gc`` removes version- or flag-STALE exports — the tending a
+long-lived warm pool needs, since until now the store only ever grew
+(a matching artifact is never touched; staleness is exactly the rule
+:meth:`WarmstartStore.load` refuses on). Exit codes: 0 ok, 1
+mismatch/failure, 2 bad usage.
 """
 
 from __future__ import annotations
@@ -52,7 +59,7 @@ from pystella_tpu.obs import events as _events
 from pystella_tpu.obs import memory as _memory
 
 __all__ = ["WarmProgram", "WarmstartStore", "export_target",
-           "main"]
+           "gc_store", "main"]
 
 #: serialized jax.export payload / metadata sidecar suffixes
 ARTIFACT_SUFFIX = ".jaxexport"
@@ -301,6 +308,49 @@ class WarmstartStore:
         return WarmProgram(exported, meta, path)
 
 
+def _gc_candidates(store):
+    """``(meta, problems)`` per stored artifact, newest first —
+    ``problems`` empty when the artifact matches the live process."""
+    return [(meta, store._mismatches(meta))
+            for meta in store.entries()]
+
+
+def gc_store(store, dry_run=False, log=None):
+    """Garbage-collect STALE artifacts (version/flag mismatch against
+    the live process): the warm pool needs a tended store — exports
+    keyed on yesterday's compiler stack only cost disk and load-time
+    refusals. Returns ``(kept, removed)`` metadata lists; with
+    ``dry_run`` nothing is deleted. Emits one ``warmstart_gc`` event.
+
+    Artifacts that merely belong to OTHER labels stay: staleness is
+    strictly the fingerprint components the loader itself refuses on
+    (:meth:`WarmstartStore.load`), so gc never removes anything load
+    would still serve."""
+    kept, removed = [], []
+    for meta, problems in _gc_candidates(store):
+        if not problems:
+            kept.append(meta)
+            continue
+        removed.append({**meta, "problems": problems})
+        if dry_run:
+            continue
+        artifact = meta.get("artifact") or (
+            f"{_safe_label(meta.get('label'))}-"
+            f"{meta.get('fingerprint')}{ARTIFACT_SUFFIX}")
+        stem = artifact[:-len(ARTIFACT_SUFFIX)] \
+            if artifact.endswith(ARTIFACT_SUFFIX) else artifact
+        for name in (artifact, stem + META_SUFFIX):
+            try:
+                os.remove(os.path.join(store.root, name))
+            except OSError:
+                pass
+    (log if log is not None else _events.get_log()).emit(
+        "warmstart_gc", dir=store.root, kept=len(kept),
+        removed=len(removed), dry_run=bool(dry_run),
+        removed_labels=[m.get("label") for m in removed][:32])
+    return kept, removed
+
+
 def export_target(store, target, log=None):
     """Build one :class:`~pystella_tpu.lint.graph.GraphTarget` (the
     registry entry the IR audit lowers) and export its program; returns
@@ -336,6 +386,23 @@ def main(argv=None):
     pv.add_argument("--dir", default=None,
                     help="artifact directory (default: "
                          "$PYSTELLA_WARMSTART_DIR)")
+    pl = sub.add_parser(
+        "list", help="enumerate stored artifacts with fingerprint, "
+                     "version, and match-status against the live "
+                     "process (informational: always exit 0)")
+    pl.add_argument("--dir", default=None,
+                    help="artifact directory (default: "
+                         "$PYSTELLA_WARMSTART_DIR)")
+    pg = sub.add_parser(
+        "gc", help="garbage-collect STALE exports (version- or "
+                   "flag-mismatched against the live process) — the "
+                   "warm pool needs a tended store; matching artifacts "
+                   "are never touched")
+    pg.add_argument("--dir", default=None,
+                    help="artifact directory (default: "
+                         "$PYSTELLA_WARMSTART_DIR)")
+    pg.add_argument("--dry-run", action="store_true",
+                    help="report what would be removed, remove nothing")
     args = p.parse_args(argv)
 
     if args.cmd == "export":
@@ -375,20 +442,46 @@ def main(argv=None):
     except ValueError as e:
         print(f"warmstart: {e}", file=sys.stderr)
         return 2
+
+    if args.cmd == "gc":
+        kept, removed = gc_store(store, dry_run=args.dry_run)
+        verb = "would remove" if args.dry_run else "removed"
+        for meta in removed:
+            print(f"warmstart: {verb} {meta.get('label')} "
+                  f"[{meta.get('fingerprint')}] "
+                  f"({'; '.join(meta.get('problems') or [])})")
+        print(f"warmstart: gc {store.root}: {len(kept)} kept, "
+              f"{len(removed)} stale artifact(s) {verb}")
+        return 0
+
     metas = store.entries()
     if not metas:
         print(f"warmstart: no artifacts under {store.root}",
               file=sys.stderr)
-        return 1
+        return 0 if args.cmd == "list" else 1
     stale = 0
     for meta in metas:
         problems = store._mismatches(meta)
         tag = "OK" if not problems else "STALE"
         stale += bool(problems)
+        extra = ""
+        if args.cmd == "list":
+            versions = (meta.get("components") or {}).get("versions")
+            extra = (f" jax={_fmt_versions(versions)} "
+                     f"{meta.get('serialized_bytes', 0):,} B "
+                     f"devices={meta.get('nr_devices')}")
         print(f"warmstart: {meta.get('label')} "
-              f"[{meta.get('fingerprint')}] {tag}"
+              f"[{meta.get('fingerprint')}] {tag}{extra}"
               + (f" ({'; '.join(problems)})" if problems else ""))
+    if args.cmd == "list":
+        return 0
     return 1 if stale else 0
+
+
+def _fmt_versions(versions):
+    if not isinstance(versions, dict):
+        return "?"
+    return "/".join(str(versions.get(k)) for k in ("jax", "jaxlib"))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,29 @@
+"""The scenario service: a persistent, multi-tenant simulation server.
+
+The composition layer over the batch-era subsystems — request ingestion
+and fair-share scheduling (:mod:`~pystella_tpu.service.queue`),
+warm-pool admission keyed on program fingerprints
+(:mod:`~pystella_tpu.service.admission`), the supervised lease loop
+over batched populations (:mod:`~pystella_tpu.service.server`),
+retire-time streamed analytics (:mod:`~pystella_tpu.service.results`),
+and the seeded synthetic load generator
+(:mod:`~pystella_tpu.service.loadgen`). ``doc/service.md`` documents
+the request lifecycle, the scheduling policy knobs, the warm-pool
+admission contract, and how to read the report's ``service`` section.
+"""
+
+from pystella_tpu.service.admission import (
+    AdmissionController, AdmissionVerdict, ColdSignature, WarmPool,
+    WarmPoolEntry, parse_signature, request_signature)
+from pystella_tpu.service.queue import (
+    FairShareScheduler, QuotaExceeded, ScenarioRequest)
+from pystella_tpu.service.results import ResultEmitter
+from pystella_tpu.service.server import ScenarioService
+from pystella_tpu.service import loadgen
+
+__all__ = [
+    "AdmissionController", "AdmissionVerdict", "ColdSignature",
+    "FairShareScheduler", "QuotaExceeded", "ResultEmitter",
+    "ScenarioRequest", "ScenarioService", "WarmPool", "WarmPoolEntry",
+    "loadgen", "parse_signature", "request_signature",
+]

@@ -1,0 +1,336 @@
+"""Warm-pool admission control keyed on program fingerprints.
+
+The serving tier's latency contract is *dispatch, never compile*: a
+request is **warm** exactly when its (model, lattice, mesh) scenario
+signature has an armed :class:`WarmPool` entry — a ready batched
+stepper whose chunk program was already traced, compiled, and dispatched
+once at arm time — whose fingerprint components (jax/jaxlib/libtpu
+versions + scheduler flags, the same identity the PR-6 compile ledger
+and :class:`~pystella_tpu.obs.warmstart.WarmstartStore` key on) still
+match the live process. A warm lease therefore does **zero** tracing and
+**zero** backend compiles; the service proves it per lease from the
+compile ledger (``service_lease.backend_compiles``) and the perf gate
+refuses a report claiming warm admissions over mismatched fingerprints.
+
+A **cold** signature (no armed entry, or a stale one) takes the
+registered ``PYSTELLA_SERVICE_COLD_POLICY``:
+
+- ``"compile"`` — admitted, queued behind the build+compile of a fresh
+  pool entry at dispatch time (its time-to-first-step pays the compile,
+  visible in the report's warm-vs-cold TTFS split);
+- ``"reject"`` — refused with a typed :class:`ColdSignature` verdict
+  (``service_reject``, reason ``cold_signature``).
+
+With a :class:`~pystella_tpu.obs.warmstart.WarmstartStore` attached, an
+armed entry is additionally audited against the newest AOT artifact
+exported under its signature label: a version/flag-stale artifact
+demotes the admission to cold (``fingerprint_ok=False`` recorded) —
+the store is the cross-process warm contract, and serving "warm" over
+a stale export is exactly the lie the gate exists to catch.
+"""
+
+from __future__ import annotations
+
+import time
+
+from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import memory as _memory
+
+__all__ = ["AdmissionController", "AdmissionVerdict", "ColdSignature",
+           "WarmPool", "WarmPoolEntry", "parse_signature",
+           "request_signature"]
+
+
+def request_signature(model, grid_shape, proc_shape=(1, 1, 1),
+                      dtype="float32"):
+    """The canonical scenario-signature string a request carries and
+    the warm pool keys on: ``model/NxNxN/PxPxP/dtype``. Two requests
+    share a signature exactly when one armed batched program can serve
+    both."""
+    return "/".join((
+        str(model),
+        "x".join(str(int(n)) for n in grid_shape),
+        "x".join(str(int(p)) for p in proc_shape),
+        str(dtype)))
+
+
+def parse_signature(signature):
+    """Inverse of :func:`request_signature`:
+    ``(model, grid_shape, proc_shape, dtype)``."""
+    parts = str(signature).split("/")
+    if len(parts) != 4:
+        raise ValueError(
+            f"malformed scenario signature {signature!r} (want "
+            "'model/NxNxN/PxPxP/dtype')")
+    model, grid, proc, dtype = parts
+    return (model,
+            tuple(int(n) for n in grid.split("x")),
+            tuple(int(p) for p in proc.split("x")),
+            dtype)
+
+
+class AdmissionVerdict:
+    """One admission decision. Truthiness is ``admitted``."""
+
+    kind = "admission"
+
+    def __init__(self, request, admitted, warm, reason="",
+                 fingerprint=None, fingerprint_ok=None):
+        self.request = request
+        self.admitted = bool(admitted)
+        self.warm = bool(warm)
+        self.reason = str(reason)
+        self.fingerprint = fingerprint
+        self.fingerprint_ok = fingerprint_ok
+
+    def __bool__(self):
+        return self.admitted
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(admitted={self.admitted}, "
+                f"warm={self.warm}, reason={self.reason!r})")
+
+
+class ColdSignature(AdmissionVerdict):
+    """The typed cold-signature verdict: the request's signature has no
+    live warm-pool entry. ``admitted`` reflects the cold policy
+    (``compile`` admits behind a build, ``reject`` refuses)."""
+
+    kind = "cold_signature"
+
+
+class WarmPoolEntry:
+    """One armed signature: the ready batched stepper and its identity.
+
+    Built by :meth:`WarmPool.arm`; holds the single-member stepper, its
+    sampler, the :class:`~pystella_tpu.ensemble.EnsembleStepper` sized
+    for the service's lease slots, the per-member sentinel, and the
+    program fingerprint (+ components) of the warmed chunk program.
+    """
+
+    def __init__(self, signature, stepper, sample, dt, ens, sentinel,
+                 fingerprint, components, decomp=None, trace_s=0.0,
+                 compile_s=0.0, param_names=(), template=None):
+        self.signature = str(signature)
+        self.stepper = stepper
+        self.sample = sample
+        self.dt = float(dt)
+        self.ens = ens
+        self.sentinel = sentinel
+        self.fingerprint = fingerprint
+        self.components = components
+        self.decomp = decomp
+        self.trace_s = float(trace_s)
+        self.compile_s = float(compile_s)
+        self.param_names = tuple(param_names)
+        self.template = template
+        self.armed_ts = time.time()
+
+    @property
+    def tick_dtype(self):
+        """The dtype the per-member ``t``/``dt``/parameter columns are
+        built in: the template state's result dtype. Feeding f64
+        columns (numpy's default) into an f32 member body would
+        PROMOTE the state inside the RK update when jax runs with x64
+        enabled — the chunk output then re-traces the warm program at
+        the next dispatch, silently breaking dispatch-never-compile.
+        One dtype, derived once from the armed avals, keeps the chunk
+        self-composing."""
+        import numpy as np
+        if not self.template:
+            return np.float32
+        import jax
+        leaves = jax.tree_util.tree_leaves(self.template[0])
+        return np.result_type(*[leaf.dtype for leaf in leaves])
+
+    def stack(self, states):
+        """Build one lease batch from member states with CANONICAL
+        dtypes and placement — the warm contract depends on both: the
+        armed chunk program was compiled against the template's leaf
+        dtypes and committed input shardings, and a later lease whose
+        batch arrives off-spec (e.g. members restored from host copies
+        after a preemption, or an f64 checkpoint of an f32 state)
+        would re-trace and recompile, silently breaking
+        dispatch-never-compile. For an ensemble decomposition
+        ``EnsembleStepper.stack`` already places members over the
+        mesh; for the single-device tier the batch is committed to the
+        entry's device explicitly."""
+        import jax
+        import jax.numpy as jnp
+        template = self.template[0] if self.template else None
+        if template is not None:
+            def _cast(t, x):
+                return x if getattr(x, "dtype", None) == t.dtype \
+                    else jnp.asarray(x, dtype=t.dtype)
+            states = [jax.tree_util.tree_map(_cast, template, s)
+                      for s in states]
+        batch = self.ens.stack(states)
+        decomp = self.decomp
+        if decomp is not None \
+                and getattr(decomp, "ensemble_axis", None) is not None:
+            return batch
+        if decomp is not None:
+            devices = list(decomp.mesh.devices.flat)
+            if len(devices) > 1:
+                # a spatially-sharded lease batch keeps whatever
+                # placement the member states carried
+                return batch
+            dev = devices[0]
+        else:
+            dev = jax.devices()[0]
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, dev), batch)
+
+    def fingerprint_ok(self):
+        """Do the entry's version/flag fingerprint components still
+        match the live process? In-process they drift only when the
+        scheduler-flag environment changes under the service — the
+        same staleness rule the AOT warm-start store enforces across
+        processes."""
+        live = _memory.fingerprint_components(self.signature)
+        saved = self.components or {}
+        return (saved.get("versions") == live.get("versions")
+                and saved.get("flags") == live.get("flags"))
+
+
+class WarmPool:
+    """The armed-signature registry: signature -> :class:`WarmPoolEntry`.
+
+    :meth:`arm` builds a signature's single-member stepper through the
+    caller's builder, wraps it in a lease-sized
+    :class:`~pystella_tpu.ensemble.EnsembleStepper`, and dispatches the
+    chunk program ONCE on a template batch under a
+    :class:`~pystella_tpu.obs.memory.compile_watch` — so every later
+    lease against this entry is a pure dispatch (the in-process jit
+    cache serves it; the compile cost is recorded here, in the
+    ``service_arm`` event, and nowhere near a request's latency).
+    """
+
+    def __init__(self):
+        self._entries = {}
+
+    def get(self, signature):
+        return self._entries.get(str(signature))
+
+    def signatures(self):
+        return sorted(self._entries)
+
+    def arm(self, signature, builder, slots, chunk, decomp=None,
+            invariants=None):
+        """Arm ``signature``: ``builder(grid_shape, decomp) ->
+        (stepper, sample, dt)`` with ``sample(seed) -> (state, params)``
+        one member's draw. Returns the entry (re-arming replaces)."""
+        import numpy as np
+        from pystella_tpu import obs
+        from pystella_tpu.ensemble import EnsembleStepper
+
+        signature = str(signature)
+        _model, grid_shape, _proc, _dtype = parse_signature(signature)
+        stepper, sample, dt = builder(grid_shape, decomp)
+        ens = EnsembleStepper(stepper, int(slots), decomp=decomp,
+                              via="vmap")
+        template_state, template_params = sample(0)
+        sentinel = obs.Sentinel.for_state(template_state,
+                                          invariants=invariants)
+        param_names = tuple(sorted(template_params or {}))
+        size = int(slots)
+        entry = WarmPoolEntry(
+            signature, stepper, sample, dt, ens, sentinel,
+            None, None, decomp=decomp, param_names=param_names,
+            template=(template_state, dict(template_params or {})))
+        batch = entry.stack([template_state] * size)
+        td = entry.tick_dtype
+        t_vec = np.zeros(size, dtype=td)
+        dt_vec = np.full(size, float(dt), dtype=td)
+        rhs = {n: np.full(size, float(template_params[n]), dtype=td)
+               for n in param_names}
+        with _memory.compile_watch(f"service.arm.{signature}") as w:
+            import jax
+            warmed, _matrix = ens.multi_step(
+                batch, int(chunk), t=t_vec, dt=dt_vec, rhs_args=rhs,
+                sentinel=sentinel)
+            jax.block_until_ready(warmed)
+        fingerprint, components = _memory.signature_fingerprint(
+            label=f"service.{signature}",
+            args=(batch, t_vec, dt_vec, rhs))
+        entry.fingerprint = fingerprint
+        entry.components = components
+        entry.trace_s = float(w.trace_seconds)
+        entry.compile_s = float(w.compile_seconds)
+        self._entries[signature] = entry
+        _events.emit("service_arm", signature=signature,
+                     fingerprint=fingerprint, slots=size,
+                     chunk=int(chunk), trace_s=round(w.trace_seconds, 4),
+                     compile_s=round(w.compile_seconds, 4),
+                     cache_hits=w.cache_hits,
+                     cache_misses=w.cache_misses)
+        return entry
+
+
+class AdmissionController:
+    """Admission decisions over a :class:`WarmPool` (+ optional
+    :class:`~pystella_tpu.obs.warmstart.WarmstartStore` audit).
+
+    :arg pool: the warm pool.
+    :arg store: optional AOT artifact store; when set, a warm admission
+        additionally requires the newest artifact labeled with the
+        signature (when one exists) to match the live process — a stale
+        export demotes the verdict to cold with
+        ``fingerprint_ok=False``.
+    :arg cold_policy: ``"compile"`` | ``"reject"`` (default: the
+        registered ``PYSTELLA_SERVICE_COLD_POLICY``).
+    """
+
+    def __init__(self, pool, store=None, cold_policy=None):
+        self.pool = pool
+        self.store = store
+        if cold_policy is None:
+            cold_policy = _config.getenv("PYSTELLA_SERVICE_COLD_POLICY")
+        cold_policy = str(cold_policy).strip().lower()
+        if cold_policy not in ("compile", "reject"):
+            raise ValueError(
+                f"unknown cold policy {cold_policy!r} (want 'compile' "
+                "or 'reject')")
+        self.cold_policy = cold_policy
+
+    def _artifact_problems(self, signature):
+        """Version/flag mismatches of the newest store artifact for
+        ``signature`` (``None`` when no store or no artifact)."""
+        if self.store is None:
+            return None
+        metas = self.store.entries(label=signature)
+        if not metas:
+            return None
+        return self.store._mismatches(metas[0])
+
+    def admit(self, request):
+        """The admission decision for one request (no queue side
+        effects — the service enqueues on a positive verdict)."""
+        entry = self.pool.get(request.signature)
+        if entry is not None:
+            problems = self._artifact_problems(request.signature)
+            if not entry.fingerprint_ok():
+                return ColdSignature(
+                    request, self.cold_policy == "compile", False,
+                    reason="stale warm-pool entry (compiler stack or "
+                           "scheduler flags changed since arm)",
+                    fingerprint=entry.fingerprint,
+                    fingerprint_ok=False)
+            if problems:
+                return ColdSignature(
+                    request, self.cold_policy == "compile", False,
+                    reason="stale AOT artifact: " + "; ".join(problems),
+                    fingerprint=entry.fingerprint,
+                    fingerprint_ok=False)
+            return AdmissionVerdict(
+                request, True, True,
+                reason="warm pool hit",
+                fingerprint=entry.fingerprint, fingerprint_ok=True)
+        admitted = self.cold_policy == "compile"
+        return ColdSignature(
+            request, admitted, False,
+            reason=("cold signature: no warm-pool entry for "
+                    f"{request.signature!r}"
+                    + ("" if admitted
+                       else " (policy rejects cold signatures)")))

@@ -1,0 +1,237 @@
+"""Seeded synthetic load for the scenario service.
+
+``run()`` stands up a :class:`~pystella_tpu.service.ScenarioService`
+around a small scalar-preheating model and drives it with a
+deterministic multi-tenant request mix that exercises every policy leg
+in one pass — the tier-1 proof (``bench.py --smoke`` wires it in; the
+TPU-window ``service`` leg scales it up):
+
+- **mixed tenants and priorities**: three tenants with 2:1:1 fair-share
+  weights submit priority-1 work against one WARM signature (armed
+  before any submission — those requests' time-to-first-step is pure
+  dispatch, proven by the lease's ``backend_compiles == 0``);
+- **one forced cold signature**: a request for a lattice no pool entry
+  serves, handled per the cold policy (default: admitted queued behind
+  the build+compile, its TTFS visibly paying it);
+- **one forced preemption**: a priority-3 request arrives (via
+  ``schedule_arrival``) while the first priority-1 lease is mid-flight;
+  the lease drains to a durable checkpoint, the high-priority request
+  is served next, and the preempted members resume bit-consistently —
+  ``run()`` re-verifies that against an uninterrupted replay through
+  the same warm program and reports ``preempt_bitexact``;
+- **one quota rejection**: the heaviest tenant submits one request past
+  its admission quota.
+
+Everything lands in the configured event log; the perf ledger's
+``service`` section and the gate's SLO verdicts consume it from there.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pystella_tpu.obs import events as _events
+from pystella_tpu.service.admission import request_signature
+from pystella_tpu.service.queue import (
+    FairShareScheduler, ScenarioRequest)
+from pystella_tpu.service.results import ResultEmitter
+from pystella_tpu.service.server import ScenarioService
+
+__all__ = ["run", "build_preheat_model"]
+
+
+def build_preheat_model(dtype=np.float32):
+    """The loadgen's scenario model: a 2-field scalar-preheating
+    system on the generic XLA path (the same physics as ``bench.py``'s
+    smoke payload, self-contained so the package needs no driver
+    import). Returns the ``builder(grid_shape, decomp)`` the service's
+    model registry wants."""
+
+    def builder(grid_shape, decomp=None):
+        import jax
+        import pystella_tpu as ps
+
+        lattice = ps.Lattice(grid_shape, (5.0,) * 3, dtype=dtype)
+        dt = dtype(0.1 * min(lattice.dx))
+        if decomp is None:
+            decomp = ps.DomainDecomposition(
+                (1, 1, 1), devices=jax.devices()[:1])
+        mphi, gsq = 1.20e-6, 2.5e-7
+
+        def potential(f):
+            phi, chi = f[0], f[1]
+            return (mphi**2 / 2 * phi**2
+                    + gsq / 2 * phi**2 * chi**2) / mphi**2
+
+        sector = ps.ScalarSector(2, potential=potential)
+        derivs = ps.FiniteDifferencer(decomp, 2, lattice.dx)
+        sector_rhs = ps.compile_rhs_dict(sector.rhs_dict)
+
+        def full_rhs(state, t, a, hubble):
+            return sector_rhs(state, t, lap_f=derivs.lap(state["f"]),
+                              a=a, hubble=hubble)
+
+        stepper = ps.LowStorageRK54(full_rhs, dt=dt)
+
+        def sample(seed):
+            rng = np.random.default_rng(1000 + seed)
+            state = {
+                "f": decomp.shard(1e-3 * rng.standard_normal(
+                    (2,) + tuple(grid_shape)).astype(dtype)),
+                "dfdt": decomp.shard(1e-4 * rng.standard_normal(
+                    (2,) + tuple(grid_shape)).astype(dtype)),
+            }
+            return state, {"a": 1.0, "hubble": 0.5}
+
+        return stepper, sample, float(dt)
+
+    return builder
+
+
+class _CapturingEmitter(ResultEmitter):
+    """Result emitter that additionally keeps the retired host states
+    (the loadgen's bit-consistency re-verification needs them; a real
+    deployment never holds them — events are the product)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.states = {}
+
+    def emit(self, request, state, **kwargs):
+        if state is not None:
+            self.states[request.id] = state
+        return super().emit(request, state, **kwargs)
+
+
+def _uninterrupted_reference(entry, request, slots, chunk):
+    """Replay ``request`` uninterrupted through the SAME warm chunk
+    program (same chunk size, ballast co-members): the reference the
+    preempted-and-resumed trajectory must match bit for bit."""
+    import jax
+
+    state, draw = entry.sample(request.seed)
+    template_state, template_draw = entry.template
+    states = [state] + [template_state] * (slots - 1)
+    batch = entry.stack(states)
+    td = entry.tick_dtype
+    dt_vec = np.full(slots, entry.dt, dtype=td)
+    params = {}
+    for n in entry.param_names:
+        col = np.full(slots, float((template_draw or {}).get(n, 0.0)),
+                      dtype=td)
+        col[0] = float((draw or {}).get(n, 0.0))
+        params[n] = col
+    n_chunks = -(-request.nsteps // chunk)
+    start = np.zeros(slots, dtype=np.int64)
+    for i in range(n_chunks):
+        t_vec = ((start + i * chunk) * dt_vec).astype(td)
+        batch, _m = entry.ens.multi_step(
+            batch, chunk, t=t_vec, dt=dt_vec, rhs_args=params,
+            sentinel=entry.sentinel)
+    jax.block_until_ready(batch)
+    return entry.ens.take_member(batch, 0)
+
+
+def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
+        cold_grid=12, nsteps=8, quota=3, label="loadgen",
+        spectra=True, faults=None, store=None):
+    """Drive one full synthetic service run (module docstring).
+    Returns the stats dict (also emitted as a ``service_loadgen``
+    event). ``grid``/``cold_grid`` are the warm/cold lattice edges;
+    ``nsteps`` the per-request step budget (a multiple of the chunk
+    keeps retire boundaries aligned); ``faults`` threads a
+    FaultInjector into every lease's supervisor (drills)."""
+    import pystella_tpu as ps
+
+    rng = np.random.default_rng(seed)
+    warm_sig = request_signature("preheat", (grid,) * 3)
+    cold_sig = request_signature("preheat", (cold_grid,) * 3)
+
+    scheduler = FairShareScheduler(
+        quota=quota, weights={"alpha": 2.0, "bravo": 1.0,
+                              "charlie": 1.0})
+    results = _CapturingEmitter(label=label)
+    service = ScenarioService(checkpoint_dir, slots=slots, chunk=chunk,
+                              scheduler=scheduler, results=results,
+                              store=store, faults=faults, label=label)
+    service.register_model("preheat", build_preheat_model())
+
+    # deploy-time arming: the warm signature's program is traced,
+    # compiled, and dispatched once HERE — before any request exists,
+    # so no request's latency ever contains it
+    service.arm(warm_sig)
+    if spectra:
+        # retire-time per-member spectra through the planner-selected
+        # transform tier (the fused pencil path whenever the service
+        # mesh makes it feasible; the single-device smoke mesh serves
+        # the same fused spectrum program through the DFT tier)
+        entry = service.pool.get(warm_sig)
+        sdec = entry.decomp or _default_decomp()
+        lat = ps.Lattice((grid,) * 3, (5.0,) * 3, dtype=np.float32)
+        fft = ps.make_dft(sdec, grid_shape=(grid,) * 3,
+                          dtype=np.float32)
+        results.spectra = ps.PowerSpectra(sdec, fft, lat.dk, lat.volume)
+        results.spectra_field = "f"
+
+    # the mix: priority-1 warm work across three tenants (alpha twice
+    # the weight), one over-quota submission, one cold signature, and
+    # a priority-3 arrival one chunk into the first lease
+    mix = [
+        ScenarioRequest("alpha", warm_sig, nsteps, seed=1),
+        ScenarioRequest("bravo", warm_sig, nsteps, seed=2,
+                        deadline_s=30.0),
+        ScenarioRequest("alpha", warm_sig, nsteps, seed=3),
+        ScenarioRequest("charlie", warm_sig, nsteps, seed=4,
+                        deadline_s=60.0),
+        ScenarioRequest("alpha", warm_sig, nsteps, seed=5),
+        ScenarioRequest("bravo", warm_sig, nsteps, seed=6),
+        # over quota: alpha already holds `quota` queued requests
+        ScenarioRequest("alpha", warm_sig, nsteps, seed=7),
+        # the forced cold signature (no pool entry for cold_grid)
+        ScenarioRequest("bravo", cold_sig, nsteps,
+                        seed=int(rng.integers(100))),
+    ]
+    verdicts = [service.submit(r) for r in mix]
+    high = ScenarioRequest("charlie", warm_sig, nsteps,
+                           seed=8, priority=3)
+    service.schedule_arrival(1, high)
+
+    summary = service.serve()
+
+    # bit-consistency re-verification: every preempted-and-resumed
+    # request's final state must equal its uninterrupted replay
+    # through the same warm chunk program
+    entry = service.pool.get(warm_sig)
+    preempted_ids = [r.id for r in mix + [high]
+                     if r.resume_step > 0]
+    bitexact = None
+    for rid in preempted_ids:
+        req = next(r for r in mix + [high] if r.id == rid)
+        got = results.states.get(rid)
+        if got is None:
+            bitexact = False
+            break
+        ref = _uninterrupted_reference(entry, req, service.slots,
+                                       service.chunk)
+        ok = all(np.array_equal(np.asarray(got[k]),
+                                np.asarray(ref[k])) for k in ref)
+        bitexact = ok if bitexact is None else (bitexact and ok)
+
+    stats = {
+        **summary,
+        "requests": len(mix) + 1,
+        "warm_admissions": sum(1 for v in verdicts
+                               if v.admitted and v.warm),
+        "cold_admissions": sum(1 for v in verdicts
+                               if v.admitted and not v.warm),
+        "preempted_requests": len(preempted_ids),
+        "preempt_bitexact": bitexact,
+    }
+    _events.emit("service_loadgen", seed=seed, **stats)
+    return stats
+
+
+def _default_decomp():
+    import jax
+    import pystella_tpu as ps
+    return ps.DomainDecomposition((1, 1, 1), devices=jax.devices()[:1])

@@ -1,0 +1,232 @@
+"""Request ingestion and fair-share scheduling for the scenario service.
+
+A :class:`ScenarioRequest` is one tenant's unit of work: a scenario
+signature (model + lattice + mesh, :func:`pystella_tpu.service.
+admission.request_signature`), a step budget, a seed, a priority class,
+and an optional deadline. The :class:`FairShareScheduler` turns a
+multi-tenant stream of them into lease-sized dispatch decisions:
+
+- **priority classes dominate**: a dispatch always serves the highest
+  priority class with queued work — and the service preempts a running
+  lower-class lease when a higher class arrives
+  (:mod:`pystella_tpu.service.server`).
+- **weighted fair share across tenants** within a class: the scheduler
+  keeps a per-tenant *deficit* (entitlement minus weighted work served;
+  serving cost ``c`` to tenant ``t`` charges ``c / weight(t)``, and the
+  counters are renormalized so the most-starved tenant sits at zero).
+  Each slot goes to the largest-deficit tenant with a queued candidate,
+  so a tenant with weight 2 gets twice the member-steps of a weight-1
+  tenant under sustained load, and an idle tenant's first request is
+  served promptly (its deficit never decayed).
+- **deadline-aware ordering** within a tenant: earliest absolute
+  deadline first (requests without one sort last), FIFO tiebreak.
+- **per-tenant admission quotas**: a tenant may hold at most ``quota``
+  queued requests; a submission beyond that raises
+  :class:`QuotaExceeded` (the service turns it into a typed
+  ``service_reject``) instead of letting one tenant starve the rest of
+  the queue.
+- **shape-compatible leases**: one lease is one batched program, so a
+  dispatch only mixes requests sharing a signature — the first pick
+  fixes it, later slots filter to it.
+
+Preempted requests re-enter through :meth:`FairShareScheduler.requeue`
+(no quota re-check — the work was already admitted; the original
+``submit_ts`` is kept so queue-latency accounting reflects the true
+wait).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+from pystella_tpu import config as _config
+
+__all__ = ["FairShareScheduler", "QuotaExceeded", "ScenarioRequest"]
+
+_request_ids = itertools.count(1)
+
+
+class QuotaExceeded(RuntimeError):
+    """A tenant tried to queue more requests than its admission quota
+    allows (``PYSTELLA_SERVICE_QUOTA`` / the scheduler's ``quota=``)."""
+
+
+class ScenarioRequest:
+    """One tenant's simulation request.
+
+    :arg tenant: tenant name (fair-share and occupancy accounting key).
+    :arg signature: the (model, lattice, mesh) scenario signature
+        (:func:`~pystella_tpu.service.admission.request_signature`) —
+        the warm-pool admission key.
+    :arg nsteps: per-member step budget.
+    :arg seed: IC sampler seed.
+    :arg priority: priority class (larger = more urgent; classes
+        strictly dominate each other in dispatch order, and a higher
+        class preempts a running lower-class lease).
+    :arg deadline_s: optional deadline in seconds FROM SUBMISSION;
+        stored as an absolute ``deadline_ts`` at :meth:`submit
+        <FairShareScheduler.submit>` time and used for EDF ordering
+        within the tenant's queue.
+    :arg label: free-form tag carried through events.
+
+    The service fills the runtime fields (``id``, ``submit_ts``,
+    ``dispatch_ts``, ``warm``, ``status``, ``resume_state``/
+    ``resume_step`` for a preempted request, ...).
+    """
+
+    def __init__(self, tenant, signature, nsteps, seed=0, priority=1,
+                 deadline_s=None, label=""):
+        self.tenant = str(tenant)
+        self.signature = str(signature)
+        self.nsteps = int(nsteps)
+        self.seed = int(seed)
+        self.priority = int(priority)
+        self.deadline_s = (None if deadline_s is None
+                           else float(deadline_s))
+        self.label = str(label)
+        if self.nsteps < 1:
+            raise ValueError("nsteps must be >= 1")
+        # runtime bookkeeping (service-owned)
+        self.id = next(_request_ids)
+        self.status = "new"
+        self.submit_ts = None
+        self.deadline_ts = None
+        self.dispatch_ts = None
+        self.queue_latency_s = None
+        self.ttfs_s = None
+        self.warm = None
+        self.fingerprint = None
+        self.fingerprint_ok = None
+        self.params_draw = None
+        self.resume_state = None
+        self.resume_step = 0
+        self.failures = 0
+
+    @property
+    def remaining_steps(self):
+        """Steps still owed (shrinks when a preemption requeues the
+        request with a restored trajectory)."""
+        return max(0, self.nsteps - int(self.resume_step))
+
+    def __repr__(self):
+        return (f"ScenarioRequest(#{self.id} {self.tenant!r} "
+                f"{self.signature!r} p{self.priority} "
+                f"nsteps={self.nsteps} status={self.status!r})")
+
+
+class FairShareScheduler:
+    """Multi-tenant fair-share + priority + deadline scheduler (module
+    docstring has the policy).
+
+    :arg quota: per-tenant queued-request cap (default: the registered
+        ``PYSTELLA_SERVICE_QUOTA``).
+    :arg weights: ``{tenant: weight}`` fair-share weights (missing
+        tenants weigh 1.0).
+    """
+
+    def __init__(self, quota=None, weights=None):
+        if quota is None:
+            quota = _config.get_int("PYSTELLA_SERVICE_QUOTA")
+        self.quota = int(quota)
+        self.weights = dict(weights or {})
+        self._queue = []
+        self._deficit = {}
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def pending(self):
+        return len(self._queue)
+
+    def queued_for(self, tenant):
+        return sum(1 for r in self._queue if r.tenant == tenant)
+
+    def weight(self, tenant):
+        w = float(self.weights.get(tenant, 1.0))
+        return w if w > 0 else 1.0
+
+    def has_priority_above(self, priority):
+        """A request of a STRICTLY higher class is waiting — the
+        service's preemption trigger."""
+        return any(r.priority > priority for r in self._queue)
+
+    # -- ingestion -----------------------------------------------------------
+
+    def submit(self, request, now=None):
+        """Enqueue ``request`` (stamping ``submit_ts`` and the absolute
+        deadline); raises :class:`QuotaExceeded` past the tenant's
+        quota."""
+        if self.queued_for(request.tenant) >= self.quota:
+            raise QuotaExceeded(
+                f"tenant {request.tenant!r} already holds "
+                f"{self.queued_for(request.tenant)} queued request(s) "
+                f"(quota {self.quota})")
+        request.submit_ts = time.time() if now is None else float(now)
+        if request.deadline_s is not None:
+            request.deadline_ts = request.submit_ts + request.deadline_s
+        request.status = "queued"
+        self._queue.append(request)
+        self._deficit.setdefault(request.tenant, 0.0)
+        return request
+
+    def requeue(self, request):
+        """Re-enter a preempted request at its original ``submit_ts``
+        (so the measured queue latency covers the full wait, preemption
+        included). No quota re-check: the work was already admitted."""
+        request.status = "queued"
+        self._queue.append(request)
+        self._deficit.setdefault(request.tenant, 0.0)
+        return request
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _charge(self, tenant, cost):
+        """Weighted-deficit bookkeeping: serving ``cost`` member-steps
+        to ``tenant`` consumes ``cost / weight`` of its entitlement;
+        renormalize so the most-starved KNOWN tenant (every tenant that
+        ever submitted holds an entry — ``submit`` seeds it) sits at
+        deficit 0, keeping the counters bounded over an unbounded
+        service lifetime."""
+        self._deficit[tenant] = (self._deficit.get(tenant, 0.0)
+                                 - cost / self.weight(tenant))
+        top = max(self._deficit.values(), default=0.0)
+        if top != 0.0:
+            for t in self._deficit:
+                self._deficit[t] -= top
+
+    def dispatch(self, slots):
+        """Pick up to ``slots`` requests for one lease: highest
+        priority class, weighted fair share across tenants, EDF within
+        a tenant, all sharing one signature (one batched program).
+        Returns the picked requests (removed from the queue; possibly
+        empty)."""
+        if not self._queue or slots < 1:
+            return []
+        pclass = max(r.priority for r in self._queue)
+        picked = []
+        signature = None
+        while len(picked) < slots:
+            pool = [r for r in self._queue
+                    if r.priority == pclass and r not in picked
+                    and (signature is None
+                         or r.signature == signature)]
+            if not pool:
+                break
+            tenants = sorted({r.tenant for r in pool})
+            tenant = max(tenants,
+                         key=lambda t: (self._deficit.get(t, 0.0), t))
+            mine = [r for r in pool if r.tenant == tenant]
+            mine.sort(key=lambda r: (
+                r.deadline_ts if r.deadline_ts is not None
+                else float("inf"),
+                r.submit_ts if r.submit_ts is not None else 0.0,
+                r.id))
+            req = mine[0]
+            signature = signature if signature is not None \
+                else req.signature
+            picked.append(req)
+            self._charge(tenant, max(1, req.remaining_steps))
+        for r in picked:
+            self._queue.remove(r)
+        return picked

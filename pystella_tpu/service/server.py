@@ -1,0 +1,571 @@
+"""The long-lived scenario service: multi-tenant populations, served.
+
+:class:`ScenarioService` is the composition the ROADMAP's production
+north-star calls for — the one-shot batch drivers become a persistent
+server:
+
+- **ingestion** — :class:`~pystella_tpu.service.queue.ScenarioRequest`
+  submissions flow through admission control
+  (:mod:`pystella_tpu.service.admission`: warm-pool hit keyed on the
+  PR-6 program fingerprints, or the cold-signature policy) into the
+  :class:`~pystella_tpu.service.queue.FairShareScheduler` (weighted
+  deficit across tenants, priority classes, per-tenant quotas,
+  deadline-aware ordering).
+- **leases** — each scheduler dispatch leases up to ``slots``
+  shape-compatible requests to one batched population: a
+  fixed-membership :class:`~pystella_tpu.ensemble.EnsembleStepper`
+  group (the ensemble engine's execution tier; the scheduler itself
+  plays the refill role the
+  :class:`~pystella_tpu.ensemble.EnsembleDriver` queue plays in batch
+  runs, and the driver's :meth:`~pystella_tpu.ensemble.EnsembleDriver.
+  requeue`/drain primitives are the same contract one level down).
+  A pool entry may own a mesh slice (``arm(decomp=)``) — that slice is
+  what the lease occupies.
+- **supervision** — every lease runs under the PR-8
+  :class:`~pystella_tpu.resilience.Supervisor`: chunk-boundary
+  checkpoints with the schedule/finalize durability split, device-loss
+  triage with restore-from-last-good and bounded replay (work lost to
+  replay is accounted per lease), and the preemption drain. A pending
+  request of a strictly higher priority class triggers
+  ``request_preemption()``; the supervisor drains at the next chunk
+  boundary — durable checkpoint, clean return — and the service
+  requeues every unfinished request WITH its restored member state, so
+  preemption loses no work and the resumed trajectory is
+  bit-consistent with an uninterrupted run. A ``planner_factory``
+  hooks the PR-11 :class:`~pystella_tpu.resilience.RemeshPlanner` in
+  per lease, so device loss on a leased mesh slice degrades instead of
+  killing the service; and a lease whose recovery fails is itself
+  contained — its requests requeue and the service keeps serving.
+- **results** — members retire through the
+  :class:`~pystella_tpu.service.results.ResultEmitter`: per-member
+  reductions and spectra summaries streamed as ``member_result``
+  events, never full field states.
+- **telemetry** — every decision is an event (``service_request`` /
+  ``service_admit`` / ``service_reject`` / ``service_dispatch`` /
+  ``service_lease`` / ``service_preempted`` / ``service_requeue`` /
+  ``member_result`` / ``service_done``); the perf ledger's ``service``
+  report section and the gate's SLO verdicts (queue-p95, warm TTFS,
+  warm-over-mismatched-fingerprints refusal) ingest exactly these
+  (``doc/service.md``).
+
+The warm-path latency contract is measurable, not aspirational: each
+lease dispatch runs under a :class:`~pystella_tpu.obs.memory.
+compile_watch`, and a warm lease records ``backend_compiles == 0`` and
+``trace_s == 0.0`` — request latency is dispatch, never compile.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import memory as _memory
+from pystella_tpu.service.admission import (
+    AdmissionController, WarmPool, parse_signature)
+from pystella_tpu.service.queue import FairShareScheduler, QuotaExceeded
+from pystella_tpu.service.results import ResultEmitter
+
+__all__ = ["ScenarioService"]
+
+
+def _ceil_div(a, b):
+    return -(-int(a) // int(b))
+
+
+class _Lease:
+    """One dispatched batch: fixed membership, supervised chunk loop.
+
+    Member ``m`` of the batch carries request ``m`` for
+    ``m < len(requests)``; the remaining slots step the template state
+    as masked ballast (the batch shape is the armed program's). All
+    host bookkeeping inside :meth:`step_fn` is a pure function of the
+    chunk index — a supervisor replay after a fault recomputes it
+    bit-identically instead of double-counting."""
+
+    def __init__(self, service, entry, requests, lease_id, t_origin,
+                 cold_build_s=0.0):
+        import numpy as np
+        from pystella_tpu.ensemble import EnsembleMonitor
+
+        self.service = service
+        self.entry = entry
+        self.requests = list(requests)
+        self.id = int(lease_id)
+        self.t_origin = float(t_origin)
+        self.cold_build_s = float(cold_build_s)
+        self.priority = max(r.priority for r in self.requests)
+        self.chunk = service.chunk
+        size = entry.ens.size
+        self.monitor = EnsembleMonitor(
+            entry.sentinel, size, every=1,
+            label=f"{service.label}.lease{self.id}",
+            max_evictions=size)
+        # the tick dtype keeps the chunk SELF-COMPOSING: f64 columns
+        # would promote an f32 state inside the RK update under x64,
+        # and the next chunk's dispatch would re-trace the warm
+        # program (see WarmPoolEntry.tick_dtype)
+        td = entry.tick_dtype
+        self.start_steps = np.zeros(size, dtype=np.int64)
+        self.dt_vec = np.full(size, entry.dt, dtype=td)
+        self.params = {n: np.zeros(size, dtype=td)
+                       for n in entry.param_names}
+        self.finish_chunks = {}
+        states = []
+        for m, req in enumerate(self.requests):
+            if req.resume_state is not None:
+                state, draw = req.resume_state, dict(req.params_draw
+                                                     or {})
+            else:
+                state, draw = entry.sample(req.seed)
+                req.params_draw = dict(draw or {})
+            states.append(state)
+            self.start_steps[m] = int(req.resume_step)
+            for n in self.params:
+                self.params[n][m] = float((draw or {}).get(n, 0.0))
+            self.finish_chunks[m] = _ceil_div(
+                max(req.remaining_steps, 1), self.chunk)
+            self.monitor.set_member(m, params={**(draw or {}),
+                                               "seed": req.seed},
+                                    scenario=req.signature)
+        template_state, template_draw = entry.template
+        for m in range(len(self.requests), size):
+            states.append(template_state)
+            self.monitor.mask_member(m)
+            for n in self.params:
+                self.params[n][m] = float(
+                    (template_draw or {}).get(n, 0.0))
+        self.batch0 = entry.stack(states)
+        self.n_chunks = max(self.finish_chunks.values())
+        self.finished = {}     # member -> host state
+        self.diverged = {}     # member -> Eviction
+        self.ttfs_s = None
+        self.supervisor = None
+        self._counted_chunks = 0
+
+    # -- the supervised chunk ------------------------------------------------
+
+    def step_fn(self, batch, i):
+        """One supervised step == one batched chunk dispatch."""
+        import jax
+
+        # only a NEW chunk advances the service clock: a supervisor
+        # REPLAY after a fault re-runs chunk indices the service
+        # already counted, and re-counting them would fire scheduled
+        # arrivals early and trigger preemption mid-recovery (the
+        # lease contract: host bookkeeping is a pure function of i)
+        if i >= self._counted_chunks:
+            self._counted_chunks = i + 1
+            self.service._on_chunk(self)
+        entry = self.entry
+        t_vec = ((self.start_steps + i * self.chunk)
+                 * self.dt_vec).astype(self.dt_vec.dtype)
+        new, matrix = entry.ens.multi_step(
+            batch, self.chunk, t=t_vec, dt=self.dt_vec,
+            rhs_args={n: self.params[n] for n in entry.param_names},
+            sentinel=entry.sentinel)
+        done = i + 1
+        self.monitor.push(done, matrix)
+        for ev in self.monitor.poll():
+            self._note_eviction(ev)
+        if self.ttfs_s is None:
+            # the one deliberate sync: time-to-first-step is a
+            # PRODUCT metric (the warm-vs-cold split the report
+            # gates), so the first chunk's completion is measured
+            # honestly rather than at async-dispatch return
+            jax.block_until_ready(new)
+            self.ttfs_s = time.perf_counter() - self.t_origin
+            for req in self.requests:
+                if req.ttfs_s is None:
+                    req.ttfs_s = self.ttfs_s
+        for m, fc in self.finish_chunks.items():
+            if fc == done and m not in self.finished \
+                    and m not in self.diverged:
+                # retire-time health check: the member's final chunks
+                # may still sit inside the maturity lag
+                ev = self.monitor.check_member_now(m, done)
+                if ev is not None:
+                    self._note_eviction(ev)
+                else:
+                    self.finished[m] = entry.ens.take_member(new, m)
+        return new
+
+    def _note_eviction(self, ev):
+        # a diverged member in a service lease is a FAILED REQUEST
+        # (reported to its tenant), never a resample — the sampler is
+        # the tenant's, and silently re-rolling their dice would
+        # falsify the result stream
+        if ev.member < len(self.requests):
+            self.diverged.setdefault(ev.member, ev)
+
+    def active_members(self):
+        return [m for m in range(len(self.requests))
+                if m not in self.finished and m not in self.diverged]
+
+    def tenant_steps(self, final_chunks):
+        """Member-steps served per tenant in this lease — a pure
+        function of the completed chunk count (replay-safe)."""
+        out = {}
+        for m, req in enumerate(self.requests):
+            chunks = min(self.finish_chunks[m], int(final_chunks))
+            steps = chunks * self.chunk
+            out[req.tenant] = out.get(req.tenant, 0) + steps
+        return out
+
+
+class ScenarioService:
+    """A persistent, multi-tenant simulation server (module docstring).
+
+    :arg checkpoint_dir: root directory for the per-lease durable
+        checkpoints (the preemption drain and device-loss recovery
+        both live here).
+    :arg slots: batch members per lease (default: registered
+        ``PYSTELLA_SERVICE_SLOTS``).
+    :arg chunk: steps per batched dispatch (default:
+        ``PYSTELLA_SERVICE_CHUNK``); preemption latency and checkpoint
+        cadence are multiples of it.
+    :arg scheduler / pool / admission / results: injectable policy
+        objects (defaults built from the registry).
+    :arg store: optional :class:`~pystella_tpu.obs.warmstart.
+        WarmstartStore` the admission controller audits warm
+        admissions against.
+    :arg preempt: enable priority preemption (default:
+        ``PYSTELLA_SERVICE_PREEMPT``).
+    :arg checkpoint_chunks: supervisor checkpoint interval in chunks.
+    :arg faults: optional :class:`~pystella_tpu.resilience.
+        FaultInjector` threaded into every lease's supervisor (drills).
+    :arg retry: :class:`~pystella_tpu.resilience.RetryPolicy` for lease
+        recovery.
+    :arg planner_factory: optional ``planner_factory(lease, entry) ->
+        RemeshPlanner | None`` — the PR-11 degraded-continuation hook
+        for leases holding a real mesh slice.
+    :arg cold_policy: admission cold policy override
+        (``PYSTELLA_SERVICE_COLD_POLICY``).
+    :arg label: tag carried on every event.
+    """
+
+    def __init__(self, checkpoint_dir, slots=None, chunk=None,
+                 scheduler=None, pool=None, admission=None, store=None,
+                 results=None, preempt=None, checkpoint_chunks=2,
+                 faults=None, retry=None, planner_factory=None,
+                 cold_policy=None, label="service"):
+        self.checkpoint_dir = os.path.abspath(str(checkpoint_dir))
+        self.slots = int(slots if slots is not None
+                         else _config.get_int("PYSTELLA_SERVICE_SLOTS"))
+        self.chunk = int(chunk if chunk is not None
+                         else _config.get_int("PYSTELLA_SERVICE_CHUNK"))
+        if self.slots < 1 or self.chunk < 1:
+            raise ValueError("slots and chunk must be >= 1")
+        self.scheduler = scheduler or FairShareScheduler()
+        self.pool = pool or WarmPool()
+        self.store = store
+        self.admission = admission or AdmissionController(
+            self.pool, store=store, cold_policy=cold_policy)
+        self.results = results or ResultEmitter(label=label)
+        if preempt is None:
+            preempt = _config.get_bool("PYSTELLA_SERVICE_PREEMPT")
+        self.preempt_enabled = bool(preempt)
+        self.checkpoint_chunks = int(checkpoint_chunks)
+        self.faults = faults
+        self.retry = retry
+        self.planner_factory = planner_factory
+        self.label = str(label)
+        self._models = {}
+        self._arrivals = []          # (due_total_chunks, request)
+        self._total_chunks = 0
+        self._lease_seq = 0
+        self.totals = {
+            "submitted": 0, "admitted": 0, "rejected": {},
+            "completed": 0, "diverged": 0, "preemptions": 0,
+            "leases": 0, "lease_failures": 0,
+            "replayed_member_steps": 0, "tenant_steps": {},
+        }
+
+    # -- model / pool management --------------------------------------------
+
+    def register_model(self, name, builder):
+        """Register a scenario model: ``builder(grid_shape, decomp) ->
+        (stepper, sample, dt)`` with ``sample(seed) -> (state, params)``
+        one member's IC draw and scalar parameter dict."""
+        self._models[str(name)] = builder
+        return self
+
+    def arm(self, signature, decomp=None, invariants=None):
+        """Arm the warm pool for ``signature`` (build + trace + compile
+        + one warm dispatch, OFF any request's latency path when called
+        at deploy time). ``decomp`` is the mesh slice the signature's
+        leases will occupy."""
+        model = parse_signature(signature)[0]
+        builder = self._models.get(model)
+        if builder is None:
+            raise KeyError(
+                f"no model {model!r} registered (signature "
+                f"{signature!r}); register_model() first")
+        return self.pool.arm(signature, builder, slots=self.slots,
+                             chunk=self.chunk, decomp=decomp,
+                             invariants=invariants)
+
+    # -- ingestion -----------------------------------------------------------
+
+    def submit(self, request):
+        """Admit + enqueue one request; returns the
+        :class:`~pystella_tpu.service.admission.AdmissionVerdict`
+        (falsy == rejected, with the typed reason)."""
+        self.totals["submitted"] += 1
+        verdict = self.admission.admit(request)
+        if not verdict.admitted:
+            return self._reject(request, verdict,
+                                verdict.kind or "cold_signature")
+        try:
+            self.scheduler.submit(request)
+        except QuotaExceeded as e:
+            verdict.admitted = False
+            verdict.reason = str(e)
+            return self._reject(request, verdict, "quota")
+        self.totals["admitted"] += 1
+        request.warm = verdict.warm
+        request.fingerprint = verdict.fingerprint
+        request.fingerprint_ok = verdict.fingerprint_ok
+        _events.emit("service_request", id=request.id,
+                     tenant=request.tenant, signature=request.signature,
+                     priority=request.priority, nsteps=request.nsteps,
+                     seed=request.seed, deadline_s=request.deadline_s,
+                     label=self.label)
+        _events.emit("service_admit", id=request.id,
+                     tenant=request.tenant, warm=verdict.warm,
+                     fingerprint=verdict.fingerprint,
+                     fingerprint_ok=verdict.fingerprint_ok,
+                     reason=verdict.reason, label=self.label)
+        return verdict
+
+    def _reject(self, request, verdict, reason_kind):
+        request.status = "rejected"
+        reasons = self.totals["rejected"]
+        reasons[reason_kind] = reasons.get(reason_kind, 0) + 1
+        _events.emit("service_reject", id=request.id,
+                     tenant=request.tenant, signature=request.signature,
+                     reason=reason_kind, detail=verdict.reason,
+                     label=self.label)
+        return verdict
+
+    def schedule_arrival(self, after_chunks, request):
+        """Deterministic mid-run arrival: submit ``request`` once the
+        service has dispatched ``after_chunks`` total chunks (the load
+        generator's preemption forcing; a live deployment just calls
+        :meth:`submit` from its frontend)."""
+        self._arrivals.append((int(after_chunks), request))
+        return self
+
+    def _poll_arrivals(self):
+        due = [r for k, r in self._arrivals
+               if self._total_chunks >= k]
+        self._arrivals = [(k, r) for k, r in self._arrivals
+                          if self._total_chunks < k]
+        for r in due:
+            self.submit(r)
+        return due
+
+    def _on_chunk(self, lease):
+        """Called by the lease at every chunk boundary: count it, admit
+        any due arrivals, and trigger the preemption drain when a
+        strictly higher priority class is now waiting."""
+        self._total_chunks += 1
+        self._poll_arrivals()
+        if (self.preempt_enabled and lease.supervisor is not None
+                and self.scheduler.has_priority_above(lease.priority)):
+            lease.supervisor.request_preemption()
+
+    # -- serving -------------------------------------------------------------
+
+    def serve(self, max_leases=None):
+        """Drain the queue (and any scheduled arrivals): dispatch
+        leases until idle. Returns the service summary dict (also
+        emitted as ``service_done``)."""
+        _events.emit("service_start", label=self.label,
+                     slots=self.slots, chunk=self.chunk,
+                     preempt=self.preempt_enabled,
+                     cold_policy=self.admission.cold_policy,
+                     quota=self.scheduler.quota)
+        leases = 0
+        while max_leases is None or leases < max_leases:
+            if not self.scheduler.pending and self._arrivals:
+                # idle service: pending arrivals are admitted now
+                # rather than waiting on chunks that will never run
+                for _k, r in self._arrivals:
+                    self.submit(r)
+                self._arrivals = []
+            if not self.scheduler.pending:
+                break
+            self._run_lease()
+            leases += 1
+        summary = {
+            "label": self.label,
+            "leases": self.totals["leases"],
+            "lease_failures": self.totals["lease_failures"],
+            "submitted": self.totals["submitted"],
+            "admitted": self.totals["admitted"],
+            "completed": self.totals["completed"],
+            "diverged": self.totals["diverged"],
+            "rejected": dict(self.totals["rejected"]),
+            "preemptions": self.totals["preemptions"],
+            "replayed_member_steps":
+                self.totals["replayed_member_steps"],
+            "tenant_steps": dict(self.totals["tenant_steps"]),
+        }
+        _events.emit("service_done", **summary)
+        return summary
+
+    def _run_lease(self):
+        requests = self.scheduler.dispatch(self.slots)
+        if not requests:
+            return None
+        t_origin = time.perf_counter()
+        signature = requests[0].signature
+        self._lease_seq += 1
+        lease_id = self._lease_seq
+        entry = self.pool.get(signature)
+        cold_build_s = 0.0
+        if entry is None or not entry.fingerprint_ok():
+            # the cold path: the request queue waits behind this
+            # build+compile, and ONLY this lease pays it — the entry
+            # then serves every later lease warm
+            t_build0 = time.perf_counter()
+            entry = self.arm(signature)
+            cold_build_s = time.perf_counter() - t_build0
+        lease_warm = cold_build_s == 0.0
+        now = time.time()
+        for r in requests:
+            r.dispatch_ts = now
+            # recomputed at EVERY dispatch against the original
+            # submit_ts: a preempted request's re-dispatch reports its
+            # cumulative wait (the requeue contract — the SLO must see
+            # the time spent parked behind the higher class, not just
+            # the pre-preemption wait)
+            r.queue_latency_s = max(0.0, now - (r.submit_ts or now))
+            r.status = "running"
+            _events.emit("service_dispatch", id=r.id, tenant=r.tenant,
+                         priority=r.priority, lease=lease_id,
+                         queue_latency_s=round(r.queue_latency_s, 6),
+                         warm=r.warm, resumed=r.resume_step > 0,
+                         label=self.label)
+        lease = _Lease(self, entry, requests, lease_id, t_origin,
+                       cold_build_s=cold_build_s)
+        self.totals["leases"] += 1
+        with _memory.compile_watch(f"service.lease{lease_id}") as w:
+            try:
+                rep = self._supervised_run(lease)
+            except Exception as e:  # noqa: BLE001 — the service survives
+                self._lease_failed(lease, e)
+                return None
+        backend_compiles = int(w.cache_misses) if (
+            w.cache_hits or w.cache_misses) else (
+            1 if w.compile_seconds > 0 else 0)
+        replayed = (rep["steps_replayed"] * self.chunk
+                    * max(1, len(lease.active_members())
+                          + len(lease.finished)))
+        self.totals["replayed_member_steps"] += replayed
+        tenant_steps = lease.tenant_steps(rep["final_step"])
+        for tenant, steps in tenant_steps.items():
+            self.totals["tenant_steps"][tenant] = \
+                self.totals["tenant_steps"].get(tenant, 0) + steps
+        _events.emit(
+            "service_lease", lease=lease_id, signature=signature,
+            priority=lease.priority, requests=len(requests),
+            warm=lease_warm, ttfs_s=lease.ttfs_s,
+            cold_build_s=round(cold_build_s, 4),
+            trace_s=round(w.trace_seconds, 4),
+            compile_s=round(w.compile_seconds, 4),
+            backend_compiles=backend_compiles,
+            chunks=rep["final_step"], preempted=rep["preempted"],
+            incidents=rep["incidents"],
+            replayed_member_steps=replayed,
+            tenant_steps=tenant_steps,
+            wall_s=round(rep["wall_s"], 4), label=self.label)
+        if rep["preempted"]:
+            self._requeue_preempted(lease, rep)
+        self._emit_results(lease)
+        return rep
+
+    def _supervised_run(self, lease):
+        from pystella_tpu import Checkpointer
+        from pystella_tpu.resilience import Supervisor
+
+        planner = (self.planner_factory(lease, lease.entry)
+                   if self.planner_factory is not None else None)
+        ck_dir = os.path.join(self.checkpoint_dir,
+                              f"lease{lease.id}")
+        with Checkpointer(ck_dir, max_to_keep=2) as ck:
+            sup = Supervisor(
+                lease.step_fn, ck, lease.n_chunks, monitor=None,
+                checkpoint_every=self.checkpoint_chunks,
+                faults=self.faults, retry=self.retry, planner=planner,
+                install_sigterm=False, keep_initial=True,
+                label=f"{self.label}.lease{lease.id}")
+            lease.supervisor = sup
+            return sup.run(lease.batch0, resume=False)
+
+    def _lease_failed(self, lease, error):
+        """A lease whose supervision gave up (recovery budget, a
+        deterministic program bug...) is contained: its unfinished
+        requests requeue — losing at most that lease's work — the
+        failure is an event, and the service keeps serving. Each
+        request carries a failure budget: after two failed leases it
+        is reported ``failed`` to its tenant instead of requeued, so a
+        request that deterministically kills its lease cannot spin the
+        service forever."""
+        self.totals["lease_failures"] += 1
+        _events.emit("service_lease_failed", lease=lease.id,
+                     signature=lease.entry.signature,
+                     error=f"{type(error).__name__}: {error}",
+                     label=self.label)
+        for m in lease.active_members():
+            req = lease.requests[m]
+            req.failures += 1
+            if req.failures >= 2:
+                req.status = "failed"
+                self.totals["diverged"] += 1
+                self.results.emit(req, None, status="failed",
+                                  lease=lease.id)
+                continue
+            req.status = "queued"
+            self.scheduler.requeue(req)
+        self._emit_results(lease)
+
+    def _requeue_preempted(self, lease, rep):
+        """The drain half of preempt-without-losing-work: the
+        supervisor already took the durable checkpoint; every
+        unfinished member's restored state re-enters the queue and its
+        next lease resumes the same trajectory."""
+        self.totals["preemptions"] += 1
+        requeued = []
+        for m in lease.active_members():
+            req = lease.requests[m]
+            req.resume_state = lease.entry.ens.take_member(
+                rep["state"], m)
+            req.resume_step = int(lease.start_steps[m]
+                                  + rep["final_step"] * lease.chunk)
+            req.status = "preempted"
+            self.scheduler.requeue(req)
+            requeued.append(req.id)
+            _events.emit("service_requeue", id=req.id,
+                         tenant=req.tenant, lease=lease.id,
+                         steps_done=req.resume_step, label=self.label)
+        _events.emit("service_preempted", lease=lease.id,
+                     requeued=requeued, at_chunk=rep["final_step"],
+                     checkpoint=rep.get("last_good"), label=self.label)
+
+    def _emit_results(self, lease):
+        for m, state in sorted(lease.finished.items()):
+            req = lease.requests[m]
+            req.status = "completed"
+            self.totals["completed"] += 1
+            self.results.emit(req, state, status="completed",
+                              lease=lease.id)
+        for m, ev in sorted(lease.diverged.items()):
+            req = lease.requests[m]
+            req.status = "diverged"
+            self.totals["diverged"] += 1
+            self.results.emit(req, None, status="diverged",
+                              lease=lease.id,
+                              diverged_fields=ev.fields)

@@ -621,6 +621,37 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     assert ff["stages"]["fft_transpose"]["count"] > 0
     assert ff["transpose_exposed_ms"] is not None
     assert "FFT / spectra" in md
+    # the scenario-service payload ran end to end: the seeded loadgen
+    # mix completed with warm admissions whose leases recorded ZERO
+    # backend compiles (the compile-ledger proof of dispatch-never-
+    # compile), one cold signature queued behind its build (cold TTFS
+    # visibly above warm), one quota rejection, and one preemption
+    # whose resumed members are bit-consistent with uninterrupted
+    # replays — the report's `service` section carries all of it
+    sv = rep["service"]
+    assert sv["completed"] == 8 and sv["diverged"] == 0
+    assert sv["rejected"] == {"quota": 1}
+    assert sv["preemptions"] == 1
+    assert sv["warm_claimed"] is True
+    assert all(a["fingerprint_ok"] for a in sv["warm_admissions"])
+    assert sv["warm_leases"] >= 3
+    assert sv["warm_lease_backend_compiles"] == 0
+    assert sv["lease_failures"] == 0
+    ql = sv["queue_latency_s"]
+    assert ql["overall"]["count"] >= 9
+    assert {"1", "3"} <= set(ql["by_priority"])
+    assert sv["ttfs_s"]["cold"]["count"] == 1
+    assert sv["ttfs_s"]["cold"]["p50_s"] > sv["ttfs_s"]["warm"]["p50_s"]
+    assert set(sv["tenant_share"]) == {"alpha", "bravo", "charlie"}
+    assert sv["loadgen"]["preempt_bitexact"] is True
+    assert "## Service" in md
+    svc_kinds = {r["kind"] for r in events.read_events(
+        os.path.join(out, "smoke_events.jsonl"))}
+    assert {"service_start", "service_request", "service_admit",
+            "service_reject", "service_arm", "service_dispatch",
+            "service_lease", "service_preempted", "service_requeue",
+            "member_result", "service_done",
+            "service_loadgen"} <= svc_kinds
     lint_rep = json.load(open(os.path.join(out, "lint_report.json")))
     spec_stats = lint_rep["graph"]["smoke_spectra"]
     coll = spec_stats["collectives"]
@@ -671,17 +702,17 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     # criterion: cache hit rate >= 0.9 and a strictly lower
     # time-to-first-step, with the warm-start round trip still
     # bit-exact
-    # (--no-ensemble/--no-supervised/--no-spectra: those payloads
-    # proved themselves on the cold leg above; rerunning them would
-    # spend tier-1 budget re-verifying the same pipeline. Gating
+    # (--no-ensemble/--no-supervised/--no-spectra/--no-service: those
+    # payloads proved themselves on the cold leg above; rerunning them
+    # would spend tier-1 budget re-verifying the same pipeline. Gating
     # warm-vs-cold below therefore also covers the lost-ensemble-,
-    # lost-resilience-, AND lost-fft-coverage WARNING paths: exit
-    # stays 0 — and the fft comparison never runs on the CPU smoke's
-    # 4-sample spectra times, which jitter beyond any honest
-    # threshold.)
+    # lost-resilience-, lost-fft-, AND lost-service-coverage WARNING
+    # paths: exit stays 0 — and the fft comparison never runs on the
+    # CPU smoke's 4-sample spectra times, which jitter beyond any
+    # honest threshold.)
     out2 = str(tmp_path / "bench_results_warm")
     res2 = run_smoke(out2, "--no-ensemble", "--no-supervised",
-                     "--no-spectra", "--no-remesh")
+                     "--no-spectra", "--no-remesh", "--no-service")
     assert res2.returncode == 0, res2.stderr[-2000:]
     warm = json.load(open(os.path.join(out2, "perf_report.json")))
     warm_cs = warm["cold_start"]
@@ -804,6 +835,32 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     verdict = gate.compare_reports(rep, drift)
     assert any("numerics regression" in r and "kinetic_mean" in r
                for r in verdict["reasons"])
+
+    # the service SLO legs on the REAL smoke report: a seeded
+    # queue-latency regression exits 1 naming the SLO, and a claimed
+    # warm admission over a mismatched fingerprint is refused (exit 2)
+    # — driven in-process (same argparse -> verdict -> exit path as
+    # the subprocess runs, without another interpreter + jax startup
+    # against the tier-1 budget)
+    slow_q = json.loads(json.dumps(rep))
+    q = slow_q["service"]["queue_latency_s"]["overall"]
+    q["p95_s"] = q["p95_s"] * 50 + 30.0
+    slow_q_path = str(tmp_path / "slow_queue.json")
+    json.dump(slow_q, open(slow_q_path, "w"))
+    assert gate.main(["--baseline", report_path,
+                      "--current", slow_q_path]) == 1
+    capsys.readouterr()
+    verdict = gate.compare_reports(rep, slow_q)
+    assert any("queue-latency p95" in r for r in verdict["reasons"])
+    bad_warm = json.loads(json.dumps(rep))
+    bad_warm["service"]["warm_admissions"][0]["fingerprint_ok"] = False
+    bad_warm_path = str(tmp_path / "bad_warm.json")
+    json.dump(bad_warm, open(bad_warm_path, "w"))
+    assert gate.main(["--baseline", report_path,
+                      "--current", bad_warm_path]) == 2
+    assert gate.main(["--baseline", report_path,
+                      "--current", bad_warm_path, "--no-service"]) == 0
+    capsys.readouterr()
 
     # the static-analysis tier ran end to end inside the smoke run: the
     # report carries a PASSING `lint` section (clean repo, donated

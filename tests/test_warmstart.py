@@ -405,5 +405,50 @@ def test_cold_to_warm_subprocess_roundtrip(tmp_path, make_decomp):
         jax.config.update("jax_compilation_cache_dir", None)
 
 
+def test_warmstart_list_and_gc(tmp_path, event_log):
+    """The store-tending satellite: ``list`` enumerates artifacts with
+    match-status, ``gc`` removes exactly the stale (version/flag-
+    mismatched) pairs and never touches a matching one — the same
+    staleness rule ``load()`` refuses on."""
+    store = warmstart.WarmstartStore(str(tmp_path / "store"))
+    x = jax.device_put(np.ones((8,), np.float32))
+    fn = jax.jit(lambda a: a * 2 + 1)
+    meta = store.save("tended", fn, (x,))
+    # a stale sibling: same label, fake fingerprint, old versions
+    stale = dict(meta, fingerprint="feedfacefeedface",
+                 artifact="tended-feedfacefeedface.jaxexport",
+                 components={**meta["components"],
+                             "versions": {"jax": "0.0.1",
+                                          "jaxlib": "0.0.1",
+                                          "libtpu": None}})
+    with open(os.path.join(store.root, stale["artifact"]), "wb") as f:
+        f.write(b"stale-bytes")
+    with open(os.path.join(
+            store.root, "tended-feedfacefeedface.meta.json"), "w") as f:
+        json.dump(stale, f)
+
+    # dry run reports without removing
+    kept, removed = warmstart.gc_store(store, dry_run=True)
+    assert [m["fingerprint"] for m in removed] == ["feedfacefeedface"]
+    assert len(kept) == 1
+    assert os.path.exists(os.path.join(store.root, stale["artifact"]))
+
+    # real gc removes the stale pair, keeps (and still loads) the match
+    kept, removed = warmstart.gc_store(store)
+    assert len(removed) == 1 and len(kept) == 1
+    assert not os.path.exists(os.path.join(store.root,
+                                           stale["artifact"]))
+    assert not os.path.exists(os.path.join(
+        store.root, "tended-feedfacefeedface.meta.json"))
+    assert store.load("tended", args=(x,)) is not None
+    gc_events = events.read_events(event_log, kind="warmstart_gc")
+    assert gc_events[-1]["data"]["removed"] == 1
+
+    # the CLI spellings, in-process (same argparse path as -m)
+    assert warmstart.main(["list", "--dir", store.root]) == 0
+    assert warmstart.main(["gc", "--dir", store.root]) == 0
+    assert warmstart.main(["verify", "--dir", store.root]) == 0
+
+
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))
