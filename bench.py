@@ -1363,7 +1363,9 @@ def run_smoke(argv=None):
                f"{svc['cold_admissions']} cold admission(s), "
                f"{sum(svc['rejected'].values())} rejected, "
                f"{svc['preemptions']} preemption(s), bit-consistent "
-               f"resume={svc['preempt_bitexact']})")
+               f"resume={svc['preempt_bitexact']}, "
+               f"{svc['deadline_misses']}/{svc['deadlined_requests']} "
+               "deadline(s) missed)")
             if not (svc["preempt_bitexact"]
                     and svc["preemptions"] >= 1
                     and svc["lease_failures"] == 0):
@@ -1371,6 +1373,34 @@ def run_smoke(argv=None):
                          preemptions=svc["preemptions"],
                          bitexact=svc["preempt_bitexact"],
                          lease_failures=svc["lease_failures"])
+            # the request-scoped trace layer, closed end to end: every
+            # loadgen request's span tree reassembles from the event
+            # log and exports as a Perfetto-loadable service timeline
+            # (the same vocabulary hardware captures fold through) —
+            # the report's `latency` section derives from the same
+            # record at ledger time
+            from pystella_tpu.obs.spans import SpanAssembler
+            asm = SpanAssembler.from_events(events_path)
+            lat = asm.summary() or {}
+            svc_trace = asm.export_perfetto(
+                os.path.join(args.out, "service_trace.json"))
+            extra = os.environ.get(
+                "PYSTELLA_TRACE_EXPORT")  # env-registry: PYSTELLA_TRACE_EXPORT
+            if svc_trace and extra:
+                asm.export_perfetto(extra)
+            obs.emit("service_trace", path=svc_trace,
+                     traced=lat.get("traced"),
+                     assembled=lat.get("assembled"),
+                     unassembled=lat.get("unassembled_total") or 0,
+                     max_rel_err=(lat.get("phase_sum_check")
+                                  or {}).get("max_rel_err"),
+                     label="smoke-service")
+            chk = lat.get("phase_sum_check") or {}
+            hb(f"smoke: service spans {lat.get('assembled')}/"
+               f"{lat.get('traced')} request tree(s) assembled, "
+               f"critical-path partition err "
+               f"{(chk.get('max_rel_err') or 0.0):.2e} "
+               f"-> {svc_trace}")
         except Exception as e:  # noqa: BLE001 — record, never kill smoke
             hb(f"smoke: service payload failed: "
                f"{type(e).__name__}: {e}")

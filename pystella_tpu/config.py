@@ -244,6 +244,21 @@ register("PYSTELLA_SERVICE_PREEMPT", default="1", kind="bool",
               "chunk boundary (drain -> durable checkpoint -> "
               "requeue, no work lost); 0 runs every lease to "
               "completion")
+register("PYSTELLA_TRACE_SERVICE", default="1", kind="bool",
+         help="request-scoped distributed tracing in the scenario "
+              "service: 1 (default) allocates a trace id per "
+              "ScenarioRequest and threads trace/span/parent fields "
+              "(event schema v2) through submission, dispatch, the "
+              "supervised lease loop, and retire, so obs.spans can "
+              "assemble per-request critical-path latency; 0 emits "
+              "v1-shaped events with no trace context")
+register("PYSTELLA_TRACE_EXPORT", default=None, kind="path",
+         help="default Perfetto output path for the assembled service "
+              "span timeline: `python -m pystella_tpu.obs.spans` "
+              "writes the request-timeline trace file there when no "
+              "explicit --perfetto is given, and bench.py --smoke "
+              "mirrors its service_trace.json export to it; unset "
+              "skips the extra copy")
 register("PYSTELLA_FFT_SCHEME", default="auto",
          help="distributed-FFT scheme the planner (fourier.plan."
               "make_dft) and the spectra/projector/Poisson consumers "

@@ -54,7 +54,8 @@ __all__ = [
 #: the canonical checker names per tier — run_lint() and the smoke
 #: run's in-run lint both derive their `checks` lists from these, so a
 #: new checker cannot silently vanish from one consumer's coverage
-SOURCE_CHECKS = ("host-sync", "env-registry", "scope-registry")
+SOURCE_CHECKS = ("host-sync", "env-registry", "scope-registry",
+                 "event-registry")
 #: the doc-coverage check: only meaningful (and only recorded) when a
 #: doc file actually exists to check against
 DOC_CHECK = "env-doc"

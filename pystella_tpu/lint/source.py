@@ -31,6 +31,11 @@ need not even be importable):
   normalize by dropping the interpolated parts, matching the trace
   parser's fold rule). This absorbs the grep that used to live in
   ``tests/test_scope_registry.py``.
+- ``event-registry`` — every literal event kind passed to an
+  ``emit(...)`` call must be registered in
+  :func:`pystella_tpu.obs.events.registered_event_kinds` (same pattern
+  as the scope registry): the span assembler's and ledger's kind
+  vocabulary cannot silently drift from the emit sites.
 
 Plus a doc-coverage check when linting the real package:
 
@@ -166,6 +171,7 @@ class _FileChecker(ast.NodeVisitor):
         self.scope_depth = 0        # inside a trace_scope/named_scope with
         self.violations = []
         self.scope_literals = {}    # name -> [lineno, ...]
+        self.emit_literals = {}     # event kind -> [lineno, ...]
         self.is_config = os.path.basename(rel) == "config.py"
 
     # -- helpers -----------------------------------------------------------
@@ -206,6 +212,15 @@ class _FileChecker(ast.NodeVisitor):
             lit = _literal_str(node.args[0])
             if lit is not None:
                 self.scope_literals.setdefault(lit, []).append(node.lineno)
+
+        # event-registry: literal kinds handed to any emit(...) call
+        # (obs.events.emit, EventLog.emit, a `log`/`sink` variable —
+        # the method NAME is the contract; non-literal first args,
+        # e.g. ResultEmitter.emit(request, ...), are simply not kinds)
+        if attr == "emit" and node.args:
+            lit = _literal_str(node.args[0])
+            if lit is not None:
+                self.emit_literals.setdefault(lit, []).append(node.lineno)
 
         # host-sync, strict set: anywhere in a hot module
         if self.hot and isinstance(node.func, ast.Attribute):
@@ -288,7 +303,8 @@ class _FileChecker(ast.NodeVisitor):
 
 
 def check_package(pkg_dir, config_path=None, doc_path=None,
-                  registered_scopes=None, checks=None):
+                  registered_scopes=None, registered_event_kinds=None,
+                  checks=None):
     """Run the source tier over ``pkg_dir``.
 
     :arg config_path: the registry module to recover env-var names from
@@ -300,9 +316,14 @@ def check_package(pkg_dir, config_path=None, doc_path=None,
         ``scope-registry`` check; default imports
         :func:`pystella_tpu.obs.scope.registered_scopes`. Pass an empty
         set to skip literal checking on fixture packages.
+    :arg registered_event_kinds: the event-kind vocabulary for the
+        ``event-registry`` check; default imports
+        :func:`pystella_tpu.obs.events.registered_event_kinds`. Same
+        fixture escape hatch as ``registered_scopes``.
     :arg checks: iterable restricting which checkers run.
     :returns: ``(violations, stats)`` where ``stats`` carries
-        ``files_scanned`` and the collected ``scope_literals`` map.
+        ``files_scanned`` and the collected ``scope_literals`` /
+        ``emit_literals`` maps.
     """
     pkg_dir = os.path.abspath(pkg_dir)
     if config_path is None:
@@ -311,10 +332,12 @@ def check_package(pkg_dir, config_path=None, doc_path=None,
     env_registry = (registered_env_vars(config_path)
                     if config_path else set())
     enabled = set(checks) if checks is not None else {
-        "host-sync", "env-registry", "scope-registry", "env-doc"}
+        "host-sync", "env-registry", "scope-registry",
+        "event-registry", "env-doc"}
 
     violations = []
     scope_literals = {}
+    emit_literals = {}
     nfiles = 0
     for path in iter_py_files(pkg_dir):
         rel = os.path.relpath(path, pkg_dir)
@@ -330,6 +353,27 @@ def check_package(pkg_dir, config_path=None, doc_path=None,
         for name, linenos in checker.scope_literals.items():
             scope_literals.setdefault(name, []).extend(
                 f"{rel}:{ln}" for ln in linenos)
+        for name, linenos in checker.emit_literals.items():
+            emit_literals.setdefault(name, []).extend(
+                f"{rel}:{ln}" for ln in linenos)
+
+    if "event-registry" in enabled and emit_literals:
+        if registered_event_kinds is None:
+            from pystella_tpu.obs.events import (
+                registered_event_kinds as _rk)
+            registered_event_kinds = _rk()
+        for name in sorted(emit_literals):
+            if name not in registered_event_kinds:
+                violations.append(Violation(
+                    checker="event-registry",
+                    message=f"event kind {name!r} is not registered: "
+                            "add a register_event_kind() entry in "
+                            "pystella_tpu/obs/events.py so the span "
+                            "assembler and ledger keep a complete kind "
+                            "vocabulary",
+                    where=emit_literals[name][0],
+                    detail={"kind": name,
+                            "sites": emit_literals[name]}))
 
     if "scope-registry" in enabled and scope_literals:
         if registered_scopes is None:
@@ -364,5 +408,6 @@ def check_package(pkg_dir, config_path=None, doc_path=None,
 
     stats = {"package": pkg_dir, "files_scanned": nfiles,
              "scope_literals": scope_literals,
+             "emit_literals": emit_literals,
              "env_registry": sorted(env_registry)}
     return violations, stats

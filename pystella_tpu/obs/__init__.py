@@ -61,11 +61,25 @@ step critical path:
   slopes, sentinel overhead) and the gate fails CI on a
   constraint-drift regression exactly like a step-time regression.
 
+The REQUEST TRACING layer (PR 13) makes the scenario service's latency
+causal, not just measured: event schema v2 carries
+``trace``/``span``/``parent`` fields through an ambient
+:func:`~pystella_tpu.obs.events.tracing` context, and
+:mod:`pystella_tpu.obs.spans` (``python -m pystella_tpu.obs.spans``)
+reassembles them into per-request span trees — critical-path phase
+decomposition, the deadline-miss ledger, and a Perfetto-loadable
+service timeline sharing the hardware traces' scope vocabulary. The
+ledger's ``latency`` section and the gate's deadline-miss SLO consume
+it; :func:`~pystella_tpu.obs.events.registered_event_kinds` is the
+central emit vocabulary the source lint audits.
+
 See ``doc/observability.md`` for the event schema and driver recipes.
 """
 
 from pystella_tpu.obs.events import (
-    EventLog, configure, emit, get_log, read_events)
+    EventLog, configure, current_trace, emit, get_log, new_span_id,
+    new_trace_id, read_events, register_event_kind,
+    registered_event_kinds, tracing)
 from pystella_tpu.obs.metrics import (
     Counter, Gauge, MetricsRegistry, Timer, counter, gauge, registry, timer)
 from pystella_tpu.obs.scope import (
@@ -77,12 +91,12 @@ from pystella_tpu.obs.memory import (
     device_memory_stats, ensure_compilation_cache, instrument_jit,
     probe_cache_donation_safety, program_fingerprint, runtime_versions,
     signature_fingerprint)
-# obs.gate and obs.warmstart are deliberately NOT imported here: their
-# primary entry points are ``python -m pystella_tpu.obs.gate`` /
-# ``... .obs.warmstart``, and runpy warns when the module is already in
-# sys.modules at -m execution time. Import them explicitly
-# (``from pystella_tpu.obs import gate, warmstart``) for programmatic
-# use.
+# obs.gate, obs.warmstart, and obs.spans are deliberately NOT imported
+# here: their primary entry points are ``python -m pystella_tpu.obs.gate``
+# / ``... .obs.warmstart`` / ``... .obs.spans``, and runpy warns when
+# the module is already in sys.modules at -m execution time. Import
+# them explicitly (``from pystella_tpu.obs import gate, spans,
+# warmstart``) for programmatic use.
 from pystella_tpu.obs import forensics, ledger, sentinel, trace
 from pystella_tpu.obs.ledger import PerfLedger, environment_fingerprint
 from pystella_tpu.obs.trace import scope_durations, summarize_trace
@@ -91,7 +105,9 @@ from pystella_tpu.obs.sentinel import (
 from pystella_tpu.obs.forensics import ForensicSink, load_bundle, write_bundle
 
 __all__ = [
-    "EventLog", "configure", "emit", "get_log", "read_events",
+    "EventLog", "configure", "current_trace", "emit", "get_log",
+    "new_span_id", "new_trace_id", "read_events",
+    "register_event_kind", "registered_event_kinds", "tracing",
     "Counter", "Gauge", "Timer", "MetricsRegistry",
     "counter", "gauge", "timer", "registry",
     "trace_scope", "traced", "lowered_scopes", "has_scope",

@@ -3,12 +3,12 @@
 Every event is one JSON object per line, appended and flushed
 immediately so a killed run keeps everything emitted before the kill
 (the property that saved round 4's bench record; ``bench.py``'s line
-cache pioneered the pattern). Schema (version 1):
+cache pioneered the pattern). Schema (version 2):
 
 ===========  ======================================================
 key          meaning
 ===========  ======================================================
-``v``        schema version (``1``)
+``v``        schema version (``2``)
 ``ts``       wall-clock POSIX seconds (cross-host correlation)
 ``mono``     ``time.monotonic()`` seconds (robust to clock steps;
              durations within one process difference correctly)
@@ -18,10 +18,30 @@ key          meaning
              ``"bench_metric"``, ``"fault_detected"``, ...). Payload
              keys must not shadow this schema's own field names —
              e.g. the resilience events carry ``fault_kind``, not
-             ``kind`` (doc/observability.md lists the vocabulary)
+             ``kind``. Every kind the package emits is registered in
+             :func:`registered_event_kinds` (the source lint's
+             ``event-registry`` check enforces it, the way the scope
+             registry gates trace-scope literals)
 ``step``     simulation step number, or ``null``
+``trace``    request-scoped trace id (v2, OPTIONAL — present only
+             when a :func:`tracing` context was active at emit time;
+             absent fields must be tolerated so v1 logs still ingest)
+``span``     the causal span this event belongs to (v2, optional)
+``parent``   the span's parent span id (v2, optional)
 ``data``     kind-specific payload (flat, JSON-safe)
 ===========  ======================================================
+
+The v2 ``trace``/``span``/``parent`` fields are the distributed-tracing
+layer: a trace id is allocated per
+:class:`~pystella_tpu.service.ScenarioRequest` and propagated through
+scheduler, admission, lease dispatch, the supervisor's chunk loop,
+checkpoint barriers, recovery, and retire — the
+:class:`~pystella_tpu.obs.spans.SpanAssembler` reconstructs per-request
+span trees and critical-path latency from exactly these fields. They
+ride an ambient thread-local context (:func:`tracing`), so existing
+``emit()`` call sites gain them without signature changes, and code
+emitting outside any context produces records indistinguishable from
+v1 apart from the version number.
 
 This module is importable without jax (the ``bench.py`` orchestrator
 process never touches jax by design); the host id is resolved lazily
@@ -32,6 +52,8 @@ Usage::
     from pystella_tpu import obs
     obs.configure("run_events.jsonl")       # or env PYSTELLA_EVENT_LOG
     obs.emit("checkpoint_save", step=1200, path="ckpts/1200")
+    with obs.events.tracing(trace=tid, span=sid):
+        obs.emit("service_dispatch", ...)   # carries trace/span/parent
     ...
     for ev in obs.read_events("run_events.jsonl"):
         ...
@@ -42,16 +64,197 @@ is a disabled sink and :func:`emit` costs one attribute check.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import secrets
 import sys
 import threading
 import time
 
-__all__ = ["EventLog", "configure", "emit", "get_log", "read_events",
-           "rotated_family", "SCHEMA_VERSION"]
+__all__ = ["EventLog", "configure", "current_trace", "emit", "get_log",
+           "new_span_id", "new_trace_id", "read_events",
+           "register_event_kind", "registered_event_kinds",
+           "rotated_family", "tracing", "SCHEMA_VERSION"]
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+
+# ---------------------------------------------------------------------------
+# trace context: the request-scoped causal-span layer (schema v2)
+# ---------------------------------------------------------------------------
+
+def new_trace_id():
+    """A fresh 16-hex-char trace id (one per request lifecycle; a
+    preempted-and-requeued request KEEPS its trace id across leases)."""
+    return secrets.token_hex(8)
+
+
+def new_span_id():
+    """A fresh 8-hex-char span id (one per causal span: the request
+    root, each lease, each recovery incident)."""
+    return secrets.token_hex(4)
+
+
+_trace_tls = threading.local()
+
+
+def current_trace():
+    """The innermost active :func:`tracing` context as a dict
+    (``trace``/``span``/``parent``), or ``None``."""
+    stack = getattr(_trace_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def tracing(trace=None, span=None, parent=None):
+    """Attach trace/span/parent fields to every event emitted inside
+    (this thread only; telemetry from helper threads degrades to
+    context-less v1-shaped records rather than mis-attributing).
+
+    Fields not given inherit from the enclosing context, with one
+    causal rule: opening a NEW span (``span=`` given, ``parent=`` not)
+    records the enclosing span as its parent — so nesting
+    ``tracing(trace=T, span=ROOT)`` → ``tracing(span=LEASE)`` emits
+    lease-scoped events carrying ``parent=ROOT`` without the inner
+    site knowing the outer ids."""
+    outer = current_trace() or {}
+    ctx = {
+        "trace": trace if trace is not None else outer.get("trace"),
+        "span": span if span is not None else outer.get("span"),
+        "parent": parent if parent is not None else (
+            outer.get("span") if span is not None
+            and span != outer.get("span")
+            else outer.get("parent")),
+    }
+    stack = getattr(_trace_tls, "stack", None)
+    if stack is None:
+        stack = _trace_tls.stack = []
+    stack.append(ctx)
+    try:
+        yield ctx
+    finally:
+        stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# event-kind registry: the emit vocabulary, centrally declared
+# ---------------------------------------------------------------------------
+
+#: kind -> one-line description; seeded below with the in-tree
+#: vocabulary. The source lint's ``event-registry`` check audits every
+#: ``emit("<literal>", ...)`` in the package against this registry
+#: (same pattern as ``obs.scope.register_scope``), so the span
+#: assembler's kind vocabulary cannot silently drift from emit sites.
+_KIND_REGISTRY = {}
+
+
+def register_event_kind(name, help=""):
+    """Register an event kind (idempotent; returns ``name``). Call this
+    for any new ``emit("<kind>", ...)`` literal — the tier-1 lint
+    (``event-registry``) fails on unregistered kinds, exactly as the
+    scope registry gates trace-scope literals."""
+    _KIND_REGISTRY.setdefault(str(name), str(help))
+    return name
+
+
+def registered_event_kinds():
+    """The registered kind vocabulary as a ``{name: description}``
+    dict (copy)."""
+    return dict(_KIND_REGISTRY)
+
+
+for _name, _help in (
+    # -- core telemetry (obs) -----------------------------------------------
+    ("step_time", "one step's wall time in ms (StepTimer emit_steps)"),
+    ("step_timer", "StepTimer window report (ms_per_step, steps_per_s)"),
+    ("compile", "one observed program compile (trace/compile split, "
+                "fingerprint, cache and memory_analysis counters)"),
+    ("compile_cache", "persistent XLA compilation cache wired"),
+    ("device_memory", "live allocator stats (TPU backends)"),
+    ("cold_start", "driver time-to-first-step phase breakdown"),
+    ("warmstart_export", "AOT artifact serialized to the store"),
+    ("warmstart_load", "AOT artifact loaded (fingerprint matched)"),
+    ("warmstart_mismatch", "AOT artifact refused (stale fingerprint)"),
+    ("warmstart_gc", "stale AOT artifacts collected"),
+    ("trace_summary", "per-scope duration table from a Perfetto capture"),
+    ("trace_missing", "a profiler capture produced no trace file"),
+    ("service_trace", "assembled service span timeline exported "
+                      "(Perfetto-loadable, obs.spans)"),
+    ("health", "one decoded sentinel health vector"),
+    ("diverged", "sentinel trip (non-finite fields / bound violation)"),
+    ("forensic_bundle", "a sentinel trip wrote a forensic bundle"),
+    ("forensic_failed", "a forensic bundle failed to write"),
+    ("perf_report", "a PerfLedger wrote perf_report.json"),
+    ("gate_verdict", "the perf gate ran (ok, exit_code, reasons)"),
+    # -- numerics / solver hot paths ----------------------------------------
+    ("mg_cycle", "one multigrid cycle (depth, smooths, errors)"),
+    ("assemble_fallback", "explicit assemble='update' fell back to the "
+                          "resident kernel tier"),
+    # -- checkpoints (utils.checkpoint) -------------------------------------
+    ("checkpoint_save", "async checkpoint write SCHEDULED (not durable)"),
+    ("checkpoint_durable", "durability barrier passed; last_good advanced"),
+    ("checkpoint_restore", "a checkpoint was restored"),
+    ("checkpoint_fallback", "restore walked back past a torn checkpoint"),
+    # -- elastic runtime (resilience) ---------------------------------------
+    ("fault_injected", "the fault harness fired a scripted fault"),
+    ("fault_detected", "the supervisor detected a fault (triage result)"),
+    ("recovery_attempt", "one recovery attempt (re-dial + restore)"),
+    ("recovery_failed", "recovery gave up (budget / recurrence)"),
+    ("run_resumed", "the run resumed (recovery MTTR or restart)"),
+    ("run_degraded", "the run re-meshed to surviving devices"),
+    ("run_preempted", "SIGTERM/preemption drain to a durable checkpoint"),
+    ("supervisor_start", "a supervised run began"),
+    ("supervisor_done", "supervised-run lifecycle totals"),
+    ("remesh_plan", "one re-mesh decision record (RemeshPlanner)"),
+    ("retry_wait", "one jittered backoff sleep (Retrier)"),
+    ("retry_stop", "the retrier stopped (reason)"),
+    # -- ensemble tier ------------------------------------------------------
+    ("ensemble_run", "ensemble-driver queue grouping"),
+    ("ensemble_chunk", "one batched dispatch window"),
+    ("ensemble_done", "ensemble batch totals (member-steps/s, occupancy)"),
+    ("ensemble_health", "per-chunk health-matrix summary"),
+    ("member_started", "a batch slot was armed with a scenario job"),
+    ("member_finished", "a member retired at its step budget"),
+    ("member_evicted", "a member was evicted by the per-member sentinel"),
+    ("member_preempted", "a driver drain captured a member as a requeue "
+                         "record"),
+    # -- scenario service ---------------------------------------------------
+    ("service_start", "scenario-service serve loop began (policy config)"),
+    ("service_done", "scenario-service serve totals"),
+    ("service_request", "one request entered ingestion (traced root)"),
+    ("service_admit", "admission verdict (warm/cold, fingerprint)"),
+    ("service_reject", "typed rejection (quota / cold_signature)"),
+    ("service_arm", "a warm-pool entry was armed (compile paid here)"),
+    ("service_dispatch", "a request entered a lease (queue latency)"),
+    ("service_lease", "a lease finished or drained (TTFS, compile watch)"),
+    ("service_preempted", "a lease drained for a higher priority class"),
+    ("service_requeue", "an unfinished request re-entered the queue with "
+                        "its restored state"),
+    ("service_lease_failed", "a lease's supervision gave up; requests "
+                             "requeued"),
+    ("member_result", "one retired member's streamed analytics + "
+                      "deadline margin"),
+    ("deadline_missed", "a deadlined request retired after its deadline "
+                        "(margin_s < 0)"),
+    ("service_loadgen", "the synthetic-mix summary"),
+    # -- driver-side kinds (bench.py / examples; outside the package, so
+    # -- not lint-audited, but registered so the vocabulary is one list)
+    ("bench_run", "bench payload run metadata"),
+    ("bench_metric", "one bench headline metric line"),
+    ("run_start", "example-driver run began"),
+    ("run_complete", "example-driver run completed"),
+    ("run_aborted", "example-driver run died (forensic tail)"),
+    ("halo_traffic", "per-device ICI bytes per overlapped halo update"),
+    ("spectra_time", "one spectra output's wall time"),
+    ("fft_spectra", "a driver's sharded-spectra leg totals"),
+    ("lint", "the static-analysis verdict of the run"),
+    ("smoke_supervised_failed", "smoke: supervised payload failed"),
+    ("smoke_remesh_failed", "smoke: remesh drill failed"),
+    ("smoke_service_failed", "smoke: service payload failed"),
+):
+    register_event_kind(_name, _help)
+del _name, _help
 
 
 def _rotated_name(path, index):
@@ -214,7 +417,9 @@ class EventLog:
     def emit(self, kind, step=None, **data):
         """Append one event; returns the record dict (``None`` when
         disabled or on a failed write — telemetry is best-effort by
-        design and must never kill the instrumented run)."""
+        design and must never kill the instrumented run). The ambient
+        :func:`tracing` context, when active on this thread, lands as
+        the v2 ``trace``/``span``/``parent`` fields."""
         if self._file is None:  # cheap pre-check; re-read under the lock
             return None
         rec = {"v": SCHEMA_VERSION, "ts": time.time(),
@@ -223,6 +428,11 @@ class EventLog:
                "kind": str(kind),
                "step": None if step is None else int(step),
                "data": _jsonify(data)}
+        ctx = current_trace()
+        if ctx:
+            for key in ("trace", "span", "parent"):
+                if ctx.get(key) is not None:
+                    rec[key] = ctx[key]
         line = json.dumps(rec)
         with self._lock:
             f = self._file  # may have been closed/reconfigured since

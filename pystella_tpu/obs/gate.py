@@ -22,7 +22,12 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       ``fft_threshold_pct`` — or a SERVICE SLO regression: the
       ``service`` section's queue-latency p95 (or warm-lease
       time-to-first-step p50) exceeds the baseline's by both the
-      configured factor and floor
+      configured factor and floor — or a DEADLINE-MISS SLO regression:
+      the ``latency`` section's deadline-miss rate exceeds the
+      baseline's by both ``latency_miss_factor`` and
+      ``latency_miss_floor`` (``--no-latency`` opts out; traced
+      requests whose span tree fails to assemble degrade to a
+      coverage-loss warning)
 2     invalid evidence: the contamination detector flagged the run
       (outlier burst / bimodal step times — the round-5 concurrent-probe
       signature), the report has no step samples, the run DIVERGED (a
@@ -216,7 +221,9 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     check_service=True, service_queue_factor=2.5,
                     service_queue_floor_s=0.5,
                     service_ttfs_factor=2.5,
-                    service_ttfs_floor_s=1.0):
+                    service_ttfs_floor_s=1.0,
+                    check_latency=True, latency_miss_factor=2.0,
+                    latency_miss_floor=0.05):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -474,6 +481,35 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                 "path is supposed to be pure dispatch; check the "
                 "service section's lease records")
 
+    if check_latency:
+        clat = current.get("latency") or {}
+        bad_asm = clat.get("unassembled") or []
+        n_bad = clat.get("unassembled_total")
+        if not isinstance(n_bad, int):
+            n_bad = len(bad_asm)  # pre-truncation-marker reports
+        if n_bad:
+            # traced requests whose span tree failed to close: the
+            # latency attribution silently lost coverage — warn (the
+            # requests may legitimately still be in flight, so this is
+            # evidence quality, not invalid evidence)
+            verdict["warnings"].append(
+                f"latency: {n_bad} traced request(s) failed to "
+                "assemble a span tree — critical-path coverage was "
+                "lost; see the report's latency.unassembled list")
+        chk = clat.get("phase_sum_check") or {}
+        if chk.get("ok") is False:
+            err = chk.get("max_rel_err")
+            tol = chk.get("tolerance")
+            detail = (
+                f" (worst rel err {err:.2%} over tolerance {tol:.0%})"
+                if isinstance(err, (int, float))
+                and isinstance(tol, (int, float)) else "")
+            verdict["warnings"].append(
+                "latency: the critical-path phases do not sum to the "
+                f"measured wall time{detail} — the span record is "
+                "internally inconsistent; treat phase attribution "
+                "with care")
+
     cur_num = current.get("numerics") or {}
     if check_numerics and cur_num.get("diverged"):
         # a diverged run's step times measure a broken computation;
@@ -626,6 +662,10 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                          queue_floor_s=service_queue_floor_s,
                          ttfs_factor=service_ttfs_factor,
                          ttfs_floor_s=service_ttfs_floor_s)
+    if check_latency:
+        _compare_latency(verdict, baseline, current,
+                         miss_factor=latency_miss_factor,
+                         miss_floor=latency_miss_floor)
     if check_resilience and (baseline or {}).get("resilience") \
             and not current.get("resilience"):
         verdict["warnings"].append(
@@ -761,6 +801,67 @@ def _compare_service(verdict, baseline, current, queue_factor=2.5,
          ttfs_factor, ttfs_floor_s, "warm time-to-first-step p50")
     if compared:
         verdict["service"] = compared
+
+
+def _compare_latency(verdict, baseline, current, miss_factor=2.0,
+                     miss_floor=0.05):
+    """Deadline-miss SLO comparison (mutates ``verdict`` in place):
+    the current ``latency.deadline.miss_rate`` — the fraction of
+    deadlined requests that retired after their deadline
+    (:mod:`pystella_tpu.obs.spans` /
+    :class:`~pystella_tpu.service.results.ResultEmitter`) — must stay
+    within ``miss_factor`` × the baseline's AND within ``miss_floor``
+    absolute above it before the gate fails (exit 1). Both bars, like
+    the other service SLOs: a smoke mix deadlines a handful of
+    requests, so one flipped verdict moves the rate by a whole
+    quantum — the floor keeps that honest while a real scheduler
+    regression (misses doubling AND growing by 5+ points) reliably
+    fails. Coverage loss (baseline had a ``latency`` section or a
+    deadline ledger, current does not) degrades to a warning; the
+    unassembled-span-tree warning runs earlier, before any baseline
+    is consulted."""
+    blat = (baseline or {}).get("latency") or {}
+    clat = current.get("latency") or {}
+    if blat and not clat:
+        verdict["warnings"].append(
+            "latency: baseline carried a latency (critical-path) "
+            "section but the current run has none — deadline-miss SLO "
+            "coverage was lost")
+        return
+    if not blat or not clat:
+        return
+    bdl = blat.get("deadline") or {}
+    cdl = clat.get("deadline") or {}
+    b = bdl.get("miss_rate")
+    c = cdl.get("miss_rate")
+    if isinstance(b, (int, float)) and c is None:
+        verdict["warnings"].append(
+            "latency: baseline tracked a deadline-miss rate but the "
+            "current run deadlined no requests — deadline-miss SLO "
+            "coverage was lost")
+        return
+    if not isinstance(b, (int, float)) or not isinstance(
+            c, (int, float)):
+        return
+    verdict["latency"] = {
+        "baseline_miss_rate": b, "current_miss_rate": c,
+        "baseline_missed": bdl.get("missed"),
+        "current_missed": cdl.get("missed"),
+        "miss_factor": miss_factor, "miss_floor": miss_floor,
+    }
+    if c > b * miss_factor and c - b > miss_floor:
+        verdict.update(ok=False, exit_code=max(verdict["exit_code"], 1))
+        verdict["reasons"].append(
+            f"deadline-miss SLO regression: miss rate {c:.1%} "
+            f"({cdl.get('missed')}/{cdl.get('deadlined')} deadlined "
+            f"request(s)) vs baseline {b:.1%} (allowed factor "
+            f"{miss_factor:g}, floor {miss_floor:g}) — see the "
+            "report's latency section for the dominant phase behind "
+            "the misses")
+    elif b > c * miss_factor and b - c > miss_floor:
+        verdict["warnings"].append(
+            f"deadline-miss improvement: miss rate {c:.1%} vs baseline "
+            f"{b:.1%} — consider refreshing the baseline")
 
 
 def _compare_ensemble(verdict, baseline, current, threshold_pct=20.0):
@@ -998,6 +1099,19 @@ def main(argv=None):
                    help="skip the scenario-service checks (queue-p95 / "
                         "warm-TTFS SLO regressions, warm-admission-"
                         "over-mismatched-fingerprints refusal)")
+    p.add_argument("--latency-miss-factor", type=float, default=2.0,
+                   help="latency: allowed multiple of the baseline's "
+                        "deadline-miss rate before the gate fails "
+                        "(default 2)")
+    p.add_argument("--latency-miss-floor", type=float, default=0.05,
+                   help="latency: absolute miss-rate increase a "
+                        "regression must also exceed (default 0.05 — "
+                        "one flipped verdict on a small smoke mix "
+                        "moves the rate by a whole quantum)")
+    p.add_argument("--no-latency", action="store_true",
+                   help="skip the request-latency checks (deadline-"
+                        "miss SLO regression, span-assembly coverage "
+                        "warnings)")
     p.add_argument("--no-resilience", action="store_true",
                    help="skip the resilience triage (degraded-fleet "
                         "annotation of regressions/contamination across "
@@ -1061,7 +1175,10 @@ def main(argv=None):
         service_queue_factor=args.service_queue_factor,
         service_queue_floor_s=args.service_queue_floor,
         service_ttfs_factor=args.service_ttfs_factor,
-        service_ttfs_floor_s=args.service_ttfs_floor)
+        service_ttfs_floor_s=args.service_ttfs_floor,
+        check_latency=not args.no_latency,
+        latency_miss_factor=args.latency_miss_factor,
+        latency_miss_floor=args.latency_miss_floor)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

@@ -266,6 +266,10 @@ class PerfLedger:
         self.service_done = {}          # last service_done payload
         self.service_loadgen = {}       # last service_loadgen payload
         self.service_lease_failures = 0  # service_lease_failed events
+        self.span_records = []          # raw trace/span-carrying events
+        #                                 (obs schema v2) — the latency
+        #                                 section's SpanAssembler input
+        self.deadline_miss_events = 0   # deadline_missed events
 
     # -- ingestion ---------------------------------------------------------
 
@@ -308,6 +312,13 @@ class PerfLedger:
         for ev in all_events:
             kind = ev.get("kind")
             data = ev.get("data") or {}
+            # the span stream: every record carrying schema-v2 trace
+            # context feeds the latency section's SpanAssembler (raw,
+            # not just data — the assembler needs ts/trace/span/parent)
+            if ev.get("trace") is not None or ev.get("span") is not None:
+                led.span_records.append(ev)
+            if kind == "deadline_missed":
+                led.deadline_miss_events += 1
             if kind == "step_time" and isinstance(
                     data.get("ms"), (int, float)):
                 led.samples_ms.append(float(data["ms"]))
@@ -1107,6 +1118,34 @@ class PerfLedger:
                           "preempt_bitexact")}
         return out
 
+    def latency(self):
+        """Request-scoped critical-path latency attribution
+        (:mod:`pystella_tpu.obs.spans` over the schema-v2 trace
+        stream): per-request phase decomposition percentiles (queue
+        wait / admission / compile / chunk compute / checkpoint
+        barrier / recovery replay / preempt drain), the dominant-phase
+        histogram, the partition audit (phases must sum to the
+        measured submit→retire wall), the deadline ledger (miss rate
+        per priority class + margin distribution — the gate's
+        deadline-miss SLO), and the coverage split (``unassembled``
+        names traced requests whose span tree failed to close — the
+        gate's coverage-loss warning). ``None`` when the run carried
+        no traced request at all (v1 logs, or
+        ``PYSTELLA_TRACE_SERVICE=0``)."""
+        if not self.span_records:
+            return None
+        # deferred import: obs.spans has a ``python -m`` entry point,
+        # and a module-level import here would put it in sys.modules
+        # before runpy executes it (same reason obs/__init__ leaves
+        # gate and warmstart out)
+        from pystella_tpu.obs import spans as _spans
+        summary = _spans.SpanAssembler.from_records(
+            self.span_records).summary()
+        if summary is not None:
+            summary["deadline"]["miss_events"] = \
+                self.deadline_miss_events
+        return summary
+
     def _degrading_plan(self):
         """The last remesh_plan that actually changed the mesh
         (``changed`` and ``feasible``), or ``None`` — transport-blip
@@ -1177,6 +1216,7 @@ class PerfLedger:
             "resilience": self.resilience(),
             "fft": self.fft(),
             "service": self.service(),
+            "latency": self.latency(),
             "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
@@ -1581,6 +1621,63 @@ def render_markdown(rep):
                 f"{_fmt(lg.get('cold_admissions'), '.0f', '0')} cold "
                 "admission(s), preempted-resume bit-exact: "
                 f"{lg.get('preempt_bitexact')}")
+        lines.append("")
+    lat = rep.get("latency")
+    if lat:
+        lines += ["## Latency (request critical path)", ""]
+        wall = lat.get("wall_s") or {}
+        lines.append(
+            f"- {_fmt(lat.get('assembled'), '.0f', '0')} of "
+            f"{_fmt(lat.get('traced'), '.0f', '0')} traced request(s) "
+            f"assembled; submit→retire wall p50 "
+            f"{_fmt(wall.get('p50_s'))} s, p95 {_fmt(wall.get('p95_s'))}"
+            " s")
+        if lat.get("unassembled"):
+            n_bad = lat.get("unassembled_total")
+            if not isinstance(n_bad, int):
+                n_bad = len(lat["unassembled"])
+            lines.append(
+                f"- **{n_bad} traced request(s) "
+                "failed to assemble** (coverage loss; see "
+                "`latency.unassembled`)")
+        chk = lat.get("phase_sum_check") or {}
+        if chk.get("max_rel_err") is not None:
+            lines.append(
+                f"- partition audit: phases sum to the wall within "
+                f"{_fmt(chk['max_rel_err'], '.2%')} worst-case "
+                f"(tolerance {_fmt(chk.get('tolerance'), '.0%')}: "
+                f"{'OK' if chk.get('ok') else '**VIOLATED**'})")
+        phases = lat.get("phases_s") or {}
+        if phases:
+            lines += ["", "| phase | requests | p50 s | p95 s | max s |",
+                      "|---|---|---|---|---|"]
+            for name, row in sorted(
+                    phases.items(),
+                    key=lambda kv: -(kv[1].get("p50_s") or 0.0)):
+                lines.append(
+                    f"| `{name}` | {row.get('count')} "
+                    f"| {_fmt(row.get('p50_s'))} "
+                    f"| {_fmt(row.get('p95_s'))} "
+                    f"| {_fmt(row.get('max_s'))} |")
+            lines.append("")
+        dom = lat.get("dominant_phase") or {}
+        if dom:
+            lines.append("- dominant phase: " + ", ".join(
+                f"`{p}` ×{n}" for p, n in sorted(
+                    dom.items(), key=lambda kv: -kv[1])))
+        dl = lat.get("deadline") or {}
+        if dl.get("deadlined"):
+            rate = dl.get("miss_rate")
+            lines.append(
+                f"- deadlines: {dl.get('missed')} of "
+                f"{dl.get('deadlined')} deadlined request(s) missed "
+                f"({_fmt(rate, '.0%')}); margin p50 "
+                f"{_fmt((dl.get('margin_s') or {}).get('p50_s'))} s")
+            for cls, row in sorted((dl.get("by_priority") or {}).items()):
+                lines.append(
+                    f"  - class {cls}: {row.get('missed')}/"
+                    f"{row.get('deadlined')} missed "
+                    f"({_fmt(row.get('miss_rate'), '.0%')})")
         lines.append("")
     ff = rep.get("fft")
     if ff:

@@ -106,6 +106,16 @@ for _name in (
     # k-space stencil application through the transform
     # (ops.fft_stencil)
     "fft_stencil",
+    # the scenario service's request-scoped span vocabulary
+    # (obs.spans): the SpanAssembler exports assembled request
+    # timelines as Perfetto complete-span rows under THESE names, so
+    # hardware profiler captures and service traces fold through one
+    # parser (obs.trace.scope_durations) — the critical-path phases...
+    "service_queue_wait", "service_admission", "service_compile",
+    "service_chunk_compute", "service_checkpoint_barrier",
+    "service_recovery_replay", "service_preempt_drain",
+    # ...plus the structural spans they hang off
+    "service_request_span", "service_lease_span",
 ):
     register_scope(_name)
 del _name

@@ -7,9 +7,16 @@ warm-pool admission keyed on program fingerprints
 over batched populations (:mod:`~pystella_tpu.service.server`),
 retire-time streamed analytics (:mod:`~pystella_tpu.service.results`),
 and the seeded synthetic load generator
-(:mod:`~pystella_tpu.service.loadgen`). ``doc/service.md`` documents
-the request lifecycle, the scheduling policy knobs, the warm-pool
-admission contract, and how to read the report's ``service`` section.
+(:mod:`~pystella_tpu.service.loadgen`). Every request carries a
+schema-v2 trace id end to end (kept across preempt → requeue), so
+:mod:`pystella_tpu.obs.spans` can attribute its latency phase by
+phase, and retire time records the deadline verdict (``margin_s``,
+``deadline_missed``). ``python -m pystella_tpu.service status``
+reconstructs queue depth / occupancy / leases / last retired requests
+from the event-log family alone. ``doc/service.md`` documents the
+request lifecycle, the scheduling policy knobs, the warm-pool
+admission contract, the SLO table, and how to read the report's
+``service`` and ``latency`` sections.
 """
 
 from pystella_tpu.service.admission import (

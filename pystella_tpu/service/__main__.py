@@ -1,0 +1,239 @@
+"""Service ops CLI: reconstruct server state from the event-log family.
+
+::
+
+    python -m pystella_tpu.service status --events run_events.jsonl \
+        [--last 10] [--json]
+
+No live server handle required: the scenario service's whole decision
+record is its event log (``service_request`` / ``service_dispatch`` /
+``service_requeue`` / ``member_result`` / ...), so an operator can ask
+"what is the queue depth, who holds the leases, what retired last?"
+of a running — or dead — service by replaying the log. Rotated
+families (``PYSTELLA_EVENT_ROTATE_MB``) are read whole, oldest first,
+exactly like the perf ledger ingests them, and the reconstruction is
+scoped to the latest serve loop — everything after the PREVIOUS
+loop's ``service_done`` — so a reused log reports the current loop
+(including its pre-``serve()`` submissions, which precede the
+``service_start`` marker), not a mix of runs.
+
+Retired rows carry each request's trace id (obs schema v2), so the
+next hop from "request 7 was slow" is
+``python -m pystella_tpu.obs.spans --events <log> --trace <id>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
+
+__all__ = ["reconstruct", "main"]
+
+
+def reconstruct(events_path):
+    """Replay the event-log family into the service's current state:
+    ``{queue: [...], queue_depth, tenants: {tenant: {...}}, leases:
+    {active, completed, failed}, armed: [...], retired: [...],
+    done: {...}}``. Pure function of the log — drives both the CLI
+    rendering and the tests."""
+    all_evs = _events.read_events(events_path, include_rotated=True)
+    # deploy-time arming happens BEFORE serve() emits service_start,
+    # so the armed-signature list reads the whole log; everything else
+    # scopes to the latest serve loop — cut at the END of the PREVIOUS
+    # loop (service_done), not at service_start: submissions precede
+    # serve() (submit() emits service_request at submit time), and
+    # slicing at the start marker would report a mid-run queue as
+    # empty, which is exactly the question this view exists to answer
+    all_arms = [ev for ev in all_evs
+                if ev.get("kind") == "service_arm"]
+    evs = all_evs
+    starts = [i for i, ev in enumerate(evs)
+              if ev.get("kind") == "service_start"]
+    if starts:
+        dones_before = [i for i in range(starts[-1])
+                        if evs[i].get("kind") == "service_done"]
+        if dones_before:
+            evs = evs[dones_before[-1] + 1:]
+    requests = {}      # id -> live request row
+    active_leases = {}  # lease id -> row
+    completed_leases = []
+    failed_leases = []
+    armed = []
+    retired = []
+    tenants = {}
+    done = None
+
+    def req(rid):
+        return requests.setdefault(rid, {"id": rid, "status": "?"})
+
+    def tenant(name):
+        return tenants.setdefault(
+            str(name), {"queued": 0, "running": 0, "retired": 0,
+                        "member_steps": 0})
+
+    for ev in all_arms:
+        data = ev.get("data") or {}
+        armed.append({"signature": data.get("signature"),
+                      "fingerprint": data.get("fingerprint"),
+                      "ts": ev.get("ts")})
+    for ev in evs:
+        kind = ev.get("kind")
+        data = ev.get("data") or {}
+        rid = data.get("id")
+        if kind == "service_request":
+            row = req(rid)
+            row.update(tenant=data.get("tenant"),
+                       signature=data.get("signature"),
+                       priority=data.get("priority"),
+                       deadline_s=data.get("deadline_s"),
+                       submit_ts=ev.get("ts"),
+                       trace=ev.get("trace"), status="queued")
+        elif kind == "service_reject":
+            req(rid).update(status="rejected",
+                            reason=data.get("reason"))
+        elif kind == "service_dispatch":
+            row = req(rid)
+            row.update(status="running", lease=data.get("lease"),
+                       queue_latency_s=data.get("queue_latency_s"))
+            lease = active_leases.setdefault(
+                data.get("lease"), {"lease": data.get("lease"),
+                                    "requests": [], "since_ts":
+                                    ev.get("ts")})
+            lease["requests"].append(rid)
+        elif kind == "service_requeue":
+            req(rid).update(status="queued", lease=None,
+                            resumed_steps=data.get("steps_done"))
+        elif kind == "service_lease":
+            lid = data.get("lease")
+            row = active_leases.pop(lid, {"lease": lid, "requests": []})
+            row.update(warm=data.get("warm"), chunks=data.get("chunks"),
+                       preempted=data.get("preempted"),
+                       wall_s=data.get("wall_s"))
+            completed_leases.append(row)
+            for t, steps in (data.get("tenant_steps") or {}).items():
+                tenant(t)["member_steps"] += int(steps)
+        elif kind == "service_lease_failed":
+            lid = data.get("lease")
+            row = active_leases.pop(lid, {"lease": lid, "requests": []})
+            row["error"] = data.get("error")
+            failed_leases.append(row)
+        elif kind == "member_result":
+            row = req(rid)
+            row.update(status=data.get("status"), lease=None)
+            retired.append({"id": rid, "tenant": data.get("tenant"),
+                            "status": data.get("status"),
+                            "trace": ev.get("trace"),
+                            "margin_s": data.get("margin_s"),
+                            "deadline_missed":
+                                data.get("deadline_missed"),
+                            "retire_ts": ev.get("ts")})
+        elif kind == "service_done":
+            done = data
+    queue = [r for r in requests.values() if r.get("status") == "queued"]
+    for r in requests.values():
+        status = r.get("status")
+        if status in ("queued", "running") and r.get("tenant"):
+            tenant(r["tenant"])[status] += 1
+    for row in retired:
+        if row.get("tenant"):
+            tenant(row["tenant"])["retired"] += 1
+    queue.sort(key=lambda r: (-(r.get("priority") or 0),
+                              r.get("submit_ts") or 0.0))
+    return {
+        "queue": queue,
+        "queue_depth": len(queue),
+        "tenants": tenants,
+        "leases": {"active": sorted(active_leases.values(),
+                                    key=lambda r: r.get("lease") or 0),
+                   "completed": len(completed_leases),
+                   "failed": len(failed_leases)},
+        "armed": armed,
+        "retired": retired,
+        "done": done,
+    }
+
+
+def _render(state, last):
+    lines = []
+    depth = state["queue_depth"]
+    leases = state["leases"]
+    lines.append(
+        f"queue depth {depth} · {len(leases['active'])} active "
+        f"lease(s) · {leases['completed']} completed, "
+        f"{leases['failed']} failed · "
+        f"{len(state['armed'])} armed signature(s)"
+        + (" · serve loop FINISHED" if state["done"] else ""))
+    if state["armed"]:
+        lines.append("armed: " + ", ".join(
+            str(a["signature"]) for a in state["armed"]))
+    for row in state["queue"][:last]:
+        lines.append(
+            f"  queued  #{row['id']} {row.get('tenant')} "
+            f"p{row.get('priority')} {row.get('signature')}"
+            + (f" (resumed at step {row['resumed_steps']})"
+               if row.get("resumed_steps") else ""))
+    for lease in state["leases"]["active"]:
+        lines.append(
+            f"  lease {lease.get('lease')} ACTIVE: request(s) "
+            f"{lease.get('requests')}")
+    if state["tenants"]:
+        lines.append("tenants:")
+        for name, row in sorted(state["tenants"].items()):
+            lines.append(
+                f"  {name}: {row['queued']} queued, {row['running']} "
+                f"running, {row['retired']} retired, "
+                f"{row['member_steps']} member-step(s) served")
+    if state["retired"]:
+        lines.append(f"last {min(last, len(state['retired']))} "
+                     "retired:")
+        for row in state["retired"][-last:]:
+            margin = row.get("margin_s")
+            lines.append(
+                f"  #{row['id']} {row.get('tenant')} "
+                f"{row.get('status')}"
+                + (f" margin {margin:+.3f}s"
+                   + (" MISSED" if row.get("deadline_missed") else "")
+                   if isinstance(margin, (int, float)) else "")
+                + (f" trace {row.get('trace')}"
+                   if row.get("trace") else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m pystella_tpu.service",
+        description="scenario-service ops tools (offline: everything "
+                    "reconstructs from the event-log family)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser(
+        "status", help="queue depth, tenant occupancy, active leases, "
+                       "and the last retired requests (with trace ids)")
+    ps.add_argument("--events", default=None,
+                    help="run-event JSONL path (default: the registered "
+                         "PYSTELLA_EVENT_LOG)")
+    ps.add_argument("--last", type=int, default=10,
+                    help="retired/queued rows to show (default 10)")
+    ps.add_argument("--json", action="store_true",
+                    help="print the raw reconstruction instead of the "
+                         "rendered view")
+    args = p.parse_args(argv)
+
+    events_path = args.events or _config.getenv("PYSTELLA_EVENT_LOG")
+    if not events_path:
+        print("service status: no --events and no PYSTELLA_EVENT_LOG "
+              "set", file=sys.stderr)
+        return 2
+    state = reconstruct(events_path)
+    if args.json:
+        print(json.dumps(state, indent=1, sort_keys=True, default=str))
+    else:
+        print(_render(state, max(1, args.last)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

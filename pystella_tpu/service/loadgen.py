@@ -175,11 +175,16 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
 
     # the mix: priority-1 warm work across three tenants (alpha twice
     # the weight), one over-quota submission, one cold signature, and
-    # a priority-3 arrival one chunk into the first lease
+    # a priority-3 arrival one chunk into the first lease. Two
+    # requests carry deadlines, one of each verdict BY CONSTRUCTION:
+    # bravo's 20 ms deadline cannot survive even a warm lease (the
+    # seeded deadline MISS the latency section and the gate's
+    # miss-rate SLO pin in tier-1), charlie's 60 s cannot be missed by
+    # a smoke mix — so both margin polarities are exercised every run
     mix = [
         ScenarioRequest("alpha", warm_sig, nsteps, seed=1),
         ScenarioRequest("bravo", warm_sig, nsteps, seed=2,
-                        deadline_s=30.0),
+                        deadline_s=0.02),
         ScenarioRequest("alpha", warm_sig, nsteps, seed=3),
         ScenarioRequest("charlie", warm_sig, nsteps, seed=4,
                         deadline_s=60.0),
@@ -217,6 +222,8 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
                                 np.asarray(ref[k])) for k in ref)
         bitexact = ok if bitexact is None else (bitexact and ok)
 
+    deadlined = [r for r in mix + [high]
+                 if r.deadline_missed is not None]
     stats = {
         **summary,
         "requests": len(mix) + 1,
@@ -226,6 +233,14 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
                                if v.admitted and not v.warm),
         "preempted_requests": len(preempted_ids),
         "preempt_bitexact": bitexact,
+        "deadlined_requests": len(deadlined),
+        "deadline_misses": sum(1 for r in deadlined
+                               if r.deadline_missed),
+        # one trace id per request, end to end: the preempted requests
+        # prove trace survival across requeue (their several
+        # service_dispatch events share the id)
+        "traces": sorted(r.trace_id for r in mix + [high]
+                         if r.trace_id is not None),
     }
     _events.emit("service_loadgen", seed=seed, **stats)
     return stats

@@ -41,6 +41,7 @@ import itertools
 import time
 
 from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
 
 __all__ = ["FairShareScheduler", "QuotaExceeded", "ScenarioRequest"]
 
@@ -90,8 +91,23 @@ class ScenarioRequest:
         # runtime bookkeeping (service-owned)
         self.id = next(_request_ids)
         self.status = "new"
+        # the request-scoped trace context (obs schema v2): allocated
+        # HERE, at the birth of the request, and carried through every
+        # lease it rides — a preempted-and-requeued request keeps ONE
+        # trace id, which is exactly what makes its cross-lease
+        # latency attributable (obs.spans). PYSTELLA_TRACE_SERVICE=0
+        # opts the whole layer out (events then stay v1-shaped).
+        if _config.get_bool("PYSTELLA_TRACE_SERVICE"):
+            self.trace_id = _events.new_trace_id()
+            self.span_id = _events.new_span_id()
+        else:
+            self.trace_id = None
+            self.span_id = None
         self.submit_ts = None
         self.deadline_ts = None
+        self.retire_ts = None
+        self.margin_s = None
+        self.deadline_missed = None
         self.dispatch_ts = None
         self.queue_latency_s = None
         self.ttfs_s = None

@@ -19,10 +19,26 @@ sync), carrying:
   (``completed`` / ``diverged``), total steps, queue latency,
   time-to-first-step, and the warm/cold admission tag, so the ledger's
   ``service`` section can split its SLO metrics without re-joining
-  event streams.
+  event streams;
+- **the deadline verdict** — retire time is where a deadline is won or
+  lost, so the retire event is where it is counted: every deadlined
+  request's record carries ``deadline_ts`` and ``margin_s``
+  (``deadline_ts - retire_ts`` — positive on a hit, negative on a
+  miss, recorded EITHER WAY so hit margins are as auditable as
+  misses), and a miss additionally emits a ``deadline_missed`` event.
+  The ledger's ``latency`` section derives the per-priority-class
+  miss rates from these and the gate's deadline-miss SLO fails CI on
+  a regression (``doc/service.md``).
+
+Every ``member_result`` also closes its request's trace (obs schema
+v2): the event carries the request's ``trace``/span fields, so the
+:class:`~pystella_tpu.obs.spans.SpanAssembler` reads it as the root
+span's end.
 """
 
 from __future__ import annotations
+
+import time
 
 import numpy as np
 
@@ -87,7 +103,12 @@ class ResultEmitter:
              diverged_fields=None):
         """Emit one ``member_result`` for ``request``'s retired host
         ``state`` (``state`` may be ``None`` for a diverged member
-        whose trajectory is not worth reducing); returns the record."""
+        whose trajectory is not worth reducing); returns the record.
+        Retire time is also the deadline verdict: a deadlined request
+        records its ``margin_s`` hit or miss, and a miss emits the
+        ``deadline_missed`` event the miss-rate SLO counts."""
+        retire_ts = time.time()
+        request.retire_ts = retire_ts
         record = {
             "id": request.id,
             "tenant": request.tenant,
@@ -102,6 +123,13 @@ class ResultEmitter:
             "queue_latency_s": request.queue_latency_s,
             "ttfs_s": request.ttfs_s,
         }
+        deadline_ts = getattr(request, "deadline_ts", None)
+        if deadline_ts is not None:
+            request.margin_s = float(deadline_ts) - retire_ts
+            request.deadline_missed = request.margin_s < 0.0
+            record["deadline_ts"] = float(deadline_ts)
+            record["margin_s"] = round(request.margin_s, 6)
+            record["deadline_missed"] = request.deadline_missed
         if diverged_fields:
             record["diverged_fields"] = sorted(diverged_fields)
         if state is not None:
@@ -110,5 +138,14 @@ class ResultEmitter:
             if spectrum is not None:
                 record["spectrum"] = spectrum
         self.records.append(record)
-        _events.emit("member_result", **record)
+        with _events.tracing(trace=getattr(request, "trace_id", None),
+                             parent=getattr(request, "span_id", None)):
+            _events.emit("member_result", **record)
+            if record.get("deadline_missed"):
+                _events.emit("deadline_missed", id=request.id,
+                             tenant=request.tenant,
+                             priority=request.priority,
+                             deadline_ts=record["deadline_ts"],
+                             margin_s=record["margin_s"],
+                             status=str(status), label=self.label)
         return record

@@ -327,26 +327,40 @@ class ScenarioService:
         request.warm = verdict.warm
         request.fingerprint = verdict.fingerprint
         request.fingerprint_ok = verdict.fingerprint_ok
-        _events.emit("service_request", id=request.id,
-                     tenant=request.tenant, signature=request.signature,
-                     priority=request.priority, nsteps=request.nsteps,
-                     seed=request.seed, deadline_s=request.deadline_s,
-                     label=self.label)
-        _events.emit("service_admit", id=request.id,
-                     tenant=request.tenant, warm=verdict.warm,
-                     fingerprint=verdict.fingerprint,
-                     fingerprint_ok=verdict.fingerprint_ok,
-                     reason=verdict.reason, label=self.label)
+        # the root span of the request's trace: submission + admission
+        # verdict (obs.spans assembles submit -> retire from here)
+        with _events.tracing(trace=request.trace_id,
+                             span=request.span_id):
+            _events.emit("service_request", id=request.id,
+                         tenant=request.tenant,
+                         signature=request.signature,
+                         priority=request.priority, nsteps=request.nsteps,
+                         seed=request.seed, deadline_s=request.deadline_s,
+                         label=self.label)
+            _events.emit("service_admit", id=request.id,
+                         tenant=request.tenant, warm=verdict.warm,
+                         fingerprint=verdict.fingerprint,
+                         fingerprint_ok=verdict.fingerprint_ok,
+                         reason=verdict.reason, label=self.label)
         return verdict
 
     def _reject(self, request, verdict, reason_kind):
         request.status = "rejected"
         reasons = self.totals["rejected"]
         reasons[reason_kind] = reasons.get(reason_kind, 0) + 1
-        _events.emit("service_reject", id=request.id,
-                     tenant=request.tenant, signature=request.signature,
-                     reason=reason_kind, detail=verdict.reason,
-                     label=self.label)
+        with _events.tracing(trace=request.trace_id,
+                             span=request.span_id):
+            _events.emit("service_request", id=request.id,
+                         tenant=request.tenant,
+                         signature=request.signature,
+                         priority=request.priority, nsteps=request.nsteps,
+                         seed=request.seed, deadline_s=request.deadline_s,
+                         label=self.label)
+            _events.emit("service_reject", id=request.id,
+                         tenant=request.tenant,
+                         signature=request.signature,
+                         reason=reason_kind, detail=verdict.reason,
+                         label=self.label)
         return verdict
 
     def schedule_arrival(self, after_chunks, request):
@@ -420,6 +434,21 @@ class ScenarioService:
         requests = self.scheduler.dispatch(self.slots)
         if not requests:
             return None
+        if all(r.trace_id is None for r in requests):
+            # PYSTELLA_TRACE_SERVICE=0: the whole layer opts out —
+            # events stay v1-shaped (no span fields) and the ledger
+            # never collects a span stream to assemble
+            return self._run_lease_traced(requests, None)
+        # one causal span per lease, shared by every request riding it:
+        # the whole lease body runs inside its tracing context, so the
+        # supervisor's chunk loop, checkpoint barriers, recovery and
+        # drain events all inherit the lease span — obs.spans attaches
+        # them to every member trace through the dispatch records below
+        lease_span = _events.new_span_id()
+        with _events.tracing(span=lease_span):
+            return self._run_lease_traced(requests, lease_span)
+
+    def _run_lease_traced(self, requests, lease_span):
         t_origin = time.perf_counter()
         signature = requests[0].signature
         self._lease_seq += 1
@@ -429,7 +458,8 @@ class ScenarioService:
         if entry is None or not entry.fingerprint_ok():
             # the cold path: the request queue waits behind this
             # build+compile, and ONLY this lease pays it — the entry
-            # then serves every later lease warm
+            # then serves every later lease warm (the service_arm event
+            # inherits the lease span, so the compile is attributable)
             t_build0 = time.perf_counter()
             entry = self.arm(signature)
             cold_build_s = time.perf_counter() - t_build0
@@ -444,11 +474,13 @@ class ScenarioService:
             # the pre-preemption wait)
             r.queue_latency_s = max(0.0, now - (r.submit_ts or now))
             r.status = "running"
-            _events.emit("service_dispatch", id=r.id, tenant=r.tenant,
-                         priority=r.priority, lease=lease_id,
-                         queue_latency_s=round(r.queue_latency_s, 6),
-                         warm=r.warm, resumed=r.resume_step > 0,
-                         label=self.label)
+            with _events.tracing(trace=r.trace_id, parent=r.span_id):
+                _events.emit("service_dispatch", id=r.id,
+                             tenant=r.tenant,
+                             priority=r.priority, lease=lease_id,
+                             queue_latency_s=round(r.queue_latency_s, 6),
+                             warm=r.warm, resumed=r.resume_step > 0,
+                             label=self.label)
         lease = _Lease(self, entry, requests, lease_id, t_origin,
                        cold_build_s=cold_build_s)
         self.totals["leases"] += 1
@@ -530,6 +562,16 @@ class ScenarioService:
                 continue
             req.status = "queued"
             self.scheduler.requeue(req)
+            # the failure-requeue is a span boundary like the
+            # preemption one: without it the request's next queue wait
+            # would be unattributable (obs.spans uses requeue events
+            # as segment starts)
+            with _events.tracing(trace=req.trace_id,
+                                 parent=req.span_id):
+                _events.emit("service_requeue", id=req.id,
+                             tenant=req.tenant, lease=lease.id,
+                             steps_done=req.resume_step,
+                             reason="lease_failed", label=self.label)
         self._emit_results(lease)
 
     def _requeue_preempted(self, lease, rep):
@@ -548,9 +590,15 @@ class ScenarioService:
             req.status = "preempted"
             self.scheduler.requeue(req)
             requeued.append(req.id)
-            _events.emit("service_requeue", id=req.id,
-                         tenant=req.tenant, lease=lease.id,
-                         steps_done=req.resume_step, label=self.label)
+            # the SAME trace id re-enters the queue: the requeued
+            # request's next lease extends this trace, which is what
+            # lets obs.spans attribute the full cross-lease wall
+            with _events.tracing(trace=req.trace_id,
+                                 parent=req.span_id):
+                _events.emit("service_requeue", id=req.id,
+                             tenant=req.tenant, lease=lease.id,
+                             steps_done=req.resume_step,
+                             reason="preempted", label=self.label)
         _events.emit("service_preempted", lease=lease.id,
                      requeued=requeued, at_chunk=rep["final_step"],
                      checkpoint=rep.get("last_good"), label=self.label)

@@ -1,0 +1,9 @@
+"""Fixture: an emit() call whose event kind no registry declares."""
+
+from pystella_tpu.obs import events as _events
+
+
+def tattle(step):
+    # seeded violation: literal event kind missing from
+    # obs.events.registered_event_kinds()
+    _events.emit("not_a_registered_event_kind", step=step, note="boom")

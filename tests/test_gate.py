@@ -650,8 +650,34 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     assert {"service_start", "service_request", "service_admit",
             "service_reject", "service_arm", "service_dispatch",
             "service_lease", "service_preempted", "service_requeue",
-            "member_result", "service_done",
-            "service_loadgen"} <= svc_kinds
+            "member_result", "service_done", "deadline_missed",
+            "service_trace", "service_loadgen"} <= svc_kinds
+    # the request-tracing layer ran end to end: every loadgen request's
+    # span tree assembled from the event log, the critical-path phases
+    # sum to the measured submit->retire wall within tolerance, the
+    # seeded deadline pair recorded one MISS and one hit, and the
+    # Perfetto service timeline sits next to the report
+    lat = rep["latency"]
+    assert lat["traced"] == lat["assembled"] == 9
+    assert lat["unassembled"] == []
+    assert lat["phase_sum_check"]["ok"] is True
+    assert lat["phase_sum_check"]["max_rel_err"] < 0.05
+    assert {"service_queue_wait", "service_chunk_compute",
+            "service_compile",
+            "service_preempt_drain"} <= set(lat["phases_s"])
+    assert lat["deadline"]["deadlined"] == 2
+    assert lat["deadline"]["missed"] == 1
+    assert lat["deadline"]["miss_rate"] == 0.5
+    assert lat["deadline"]["by_priority"]["1"]["missed"] == 1
+    preempted_rows = [r for r in lat["requests"] if r["leases"] > 1]
+    assert preempted_rows, "the preempted requests cross >1 lease"
+    assert "## Latency (request critical path)" in md
+    svc_trace_path = os.path.join(out, "service_trace.json")
+    assert os.path.exists(svc_trace_path)
+    from pystella_tpu.obs import trace as obs_trace
+    svc_rows = obs_trace.parse_trace_file(svc_trace_path)
+    svc_table = obs_trace.scope_durations(svc_rows)
+    assert svc_table.get("service_request_span", {}).get("count") == 9
     lint_rep = json.load(open(os.path.join(out, "lint_report.json")))
     spec_stats = lint_rep["graph"]["smoke_spectra"]
     coll = spec_stats["collectives"]
@@ -860,6 +886,25 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
                       "--current", bad_warm_path]) == 2
     assert gate.main(["--baseline", report_path,
                       "--current", bad_warm_path, "--no-service"]) == 0
+    capsys.readouterr()
+
+    # the deadline-miss SLO leg on the REAL smoke report: against a
+    # clean baseline (misses zeroed) the run's seeded miss drives the
+    # gate to exit 1 naming the SLO; --no-latency opts out — and the
+    # self-comparison above already proved equal miss rates pass
+    clean_dl = json.loads(json.dumps(rep))
+    clean_dl["latency"]["deadline"].update(missed=0, miss_rate=0.0)
+    clean_dl_path = str(tmp_path / "clean_deadline.json")
+    json.dump(clean_dl, open(clean_dl_path, "w"))
+    assert gate.main(["--baseline", clean_dl_path,
+                      "--current", report_path]) == 1
+    capsys.readouterr()
+    verdict = gate.compare_reports(clean_dl, rep)
+    assert any("deadline-miss SLO regression" in r
+               for r in verdict["reasons"])
+    assert verdict["latency"]["current_miss_rate"] == 0.5
+    assert gate.main(["--baseline", clean_dl_path,
+                      "--current", report_path, "--no-latency"]) == 0
     capsys.readouterr()
 
     # the static-analysis tier ran end to end inside the smoke run: the

@@ -69,6 +69,9 @@ def test_source_tier_names_seeded_violations():
     # unregistered trace-scope literal
     assert any("not_a_registered_scope" in v.message
                for v in by_checker["scope-registry"])
+    # unregistered event kind handed to emit()
+    assert any("not_a_registered_event_kind" in v.message
+               for v in by_checker["event-registry"])
 
 
 def test_source_tier_pragma_waives():

@@ -470,6 +470,12 @@ def test_loadgen_mix_and_service_section(loadgen_report):
     assert stats["warm_admissions"] == 6
     assert stats["cold_admissions"] == 1
     assert stats["completed"] == 8
+    # the seeded deadline pair: bravo's 20 ms deadline cannot survive
+    # a lease (the one MISS), charlie's 60 s cannot be missed (the
+    # one HIT with margin) — both polarities recorded every run
+    assert stats["deadlined_requests"] == 2
+    assert stats["deadline_misses"] == 1
+    assert len(stats["traces"]) == stats["requests"]
 
     sv = rep["service"]
     assert sv["completed"] == 8 and sv["diverged"] == 0
@@ -490,6 +496,26 @@ def test_loadgen_mix_and_service_section(loadgen_report):
     assert set(sv["tenant_share"]) == {"alpha", "bravo", "charlie"}
     assert abs(sum(sv["tenant_share"].values()) - 1.0) < 1e-9
     assert sv["loadgen"]["preempt_bitexact"] is True
+
+    # the latency section: every traced request's span tree assembled,
+    # the critical-path partition audited within tolerance, and the
+    # deadline ledger carrying the seeded miss
+    lat = rep["latency"]
+    assert lat["traced"] == lat["assembled"] == stats["requests"]
+    assert lat["unassembled"] == []
+    assert lat["phase_sum_check"]["ok"] is True
+    assert lat["phase_sum_check"]["max_rel_err"] < 0.05
+    assert {"service_queue_wait", "service_chunk_compute",
+            "service_compile"} <= set(lat["phases_s"])
+    assert lat["deadline"]["deadlined"] == 2
+    assert lat["deadline"]["missed"] == 1
+    assert lat["deadline"]["miss_rate"] == 0.5
+    assert lat["deadline"]["miss_events"] == 1
+    assert lat["deadline"]["by_priority"]["1"]["missed"] == 1
+    # hit AND miss margins both recorded
+    margins = [r["margin_s"] for r in lat["requests"]
+               if r["margin_s"] is not None]
+    assert any(m < 0 for m in margins) and any(m > 0 for m in margins)
 
 
 def test_loadgen_gate_slo_legs(loadgen_report):
@@ -539,6 +565,38 @@ def test_loadgen_gate_slo_legs(loadgen_report):
     assert v["exit_code"] == 0
     assert any("SLO coverage was lost" in w_ or
                "service section but the current run has none" in w_
+               for w_ in v["warnings"])
+
+    # seeded deadline-miss regression -> exit 1 (a clean baseline — no
+    # misses — against the current run's seeded miss clears both the
+    # factor and the floor); --no-latency / check_latency=False opt out
+    clean = copy.deepcopy(rep)
+    clean["latency"]["deadline"].update(missed=0, miss_rate=0.0)
+    v = gate.compare_reports(clean, rep)
+    assert v["exit_code"] == 1
+    assert any("deadline-miss SLO regression" in r for r in v["reasons"])
+    assert gate.compare_reports(clean, rep,
+                                check_latency=False)["exit_code"] == 0
+    # ... and the improvement direction merely warns
+    v = gate.compare_reports(rep, clean)
+    assert v["exit_code"] == 0
+    assert any("deadline-miss improvement" in w_ for w_ in v["warnings"])
+
+    # an unassembled span tree is a coverage-loss warning, never a
+    # refusal (the request may legitimately still be in flight)
+    partial = copy.deepcopy(rep)
+    partial["latency"]["unassembled"] = [
+        {"trace": "dead", "id": 99, "problems": ["no terminal event"]}]
+    partial["latency"]["unassembled_total"] = 1
+    v = gate.compare_reports(rep, partial)
+    assert v["exit_code"] == 0
+    assert any("failed to assemble" in w_ for w_ in v["warnings"])
+
+    # losing the whole latency section relative to the baseline warns
+    nolat = {k: v2 for k, v2 in rep.items() if k != "latency"}
+    v = gate.compare_reports(rep, nolat)
+    assert v["exit_code"] == 0
+    assert any("deadline-miss SLO coverage was lost" in w_
                for w_ in v["warnings"])
 
 
