@@ -1366,6 +1366,17 @@ def run_smoke(argv=None):
                f"resume={svc['preempt_bitexact']}, "
                f"{svc['deadline_misses']}/{svc['deadlined_requests']} "
                "deadline(s) missed)")
+            slo = svc.get("slo") or {}
+            if slo:
+                # the seeded live burn alert: fires on the guaranteed
+                # deadline miss, resolves on the next guaranteed hit —
+                # both transitions must be in every smoke record
+                hb(f"smoke: service slo {slo['alerts']} alert(s) "
+                   f"fired / {slo['resolved']} resolved"
+                   + (f", STILL BURNING: {slo['alerting']}"
+                      if slo.get("alerting") else "")
+                   + f" (monitor overhead {slo['overhead_pct']:.3f}% "
+                   "of serve wall)")
             if not (svc["preempt_bitexact"]
                     and svc["preemptions"] >= 1
                     and svc["lease_failures"] == 0):

@@ -56,7 +56,13 @@ costs one leg, not the window):
    warm time-to-first-step p50 (the dispatch-never-compile contract —
    warm leases must record zero backend compiles), and the preemption
    MTTR (``service_preempted`` to the first resumed re-dispatch),
-   which CPU rehearsal cannot price.
+   which CPU rehearsal cannot price. PR 14's live operations plane
+   rides the same leg: the ``PYSTELLA_LIVE_PORT`` endpoint comes up
+   with the serve loop, a scraper thread polls ``/metrics`` and
+   ``/slo`` mid-loadgen, and the last successful scrape (service
+   gauges, burn-rate state) plus the ledger's ``alerts`` section land
+   in the leg record — the first hardware window also validates the
+   live plane.
 9. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
    wires a FRESH ``PYSTELLA_COMPILE_CACHE_DIR``, builds the 512³
    multigrid + preheat step programs cold (recording
@@ -468,7 +474,13 @@ def worker_service(dry_run):
     for a hardware-scale signature — on-hardware queue-p95, warm TTFS,
     and preemption MTTR (drain -> durable checkpoint -> resumed
     re-dispatch), with the warm path's zero-backend-compile contract
-    checked from the same run's compile ledger."""
+    checked from the same run's compile ledger. The live operations
+    plane rides the same leg: ``PYSTELLA_LIVE_PORT`` is armed, a
+    scraper thread polls ``/metrics`` and ``/slo`` MID-loadgen, and the
+    last successful scrape lands in the leg record — the first
+    hardware window then also validates the live plane."""
+    import threading
+
     backend, ndev, dial_s = _dial(dry_run)
     sys.path.insert(0, REPO)
     from pystella_tpu import obs
@@ -489,10 +501,61 @@ def worker_service(dry_run):
     ck_dir = os.path.join(OUT, "tpu_window_service_ckpt")
     import shutil
     shutil.rmtree(ck_dir, ignore_errors=True)
+
+    # the live plane: serve() brings the endpoint up on this port for
+    # the duration of the loadgen's serve loop; the scraper below is
+    # the "operator" hitting it mid-run
+    live_port = int(os.environ.get("PYSTELLA_LIVE_PORT") or 0) or 8745
+    os.environ["PYSTELLA_LIVE_PORT"] = str(live_port)
+    scrape = {}
+    stop_scraper = threading.Event()
+
+    def scraper():
+        import urllib.request
+        base = f"http://127.0.0.1:{live_port}"
+        while not stop_scraper.is_set():
+            try:
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=1) as r:
+                    text = r.read().decode()
+                with urllib.request.urlopen(base + "/slo",
+                                            timeout=1) as r:
+                    slo = json.loads(r.read().decode())
+                with urllib.request.urlopen(base + "/healthz",
+                                            timeout=1) as r:
+                    healthz = json.loads(r.read().decode())
+                metrics = {}
+                for ln in text.splitlines():
+                    if ln.startswith("pystella_service_") and " " in ln:
+                        name, _, val = ln.rpartition(" ")
+                        try:
+                            metrics[name] = float(val)
+                        except ValueError:
+                            pass
+                scrape.update(ts=time.time(), metrics=metrics,
+                              slo={"alerting": slo.get("alerting"),
+                                   "alerts_total":
+                                       slo.get("alerts_total"),
+                                   "resolved_total":
+                                       slo.get("resolved_total")},
+                              healthz={"serving": healthz.get("serving"),
+                                       "queue_depth":
+                                           healthz.get("queue_depth")},
+                              scrapes=scrape.get("scrapes", 0) + 1)
+            except Exception:  # noqa: BLE001 — endpoint not up yet
+                pass
+            stop_scraper.wait(0.2)
+
+    scraper_thread = threading.Thread(target=scraper, daemon=True)
+    scraper_thread.start()
     t0 = time.perf_counter()
-    stats = loadgen.run(ck_dir, seed=17, slots=slots, grid=grid,
-                        cold_grid=12 if dry_run else 256,
-                        label=f"window-service-{grid}^3")
+    try:
+        stats = loadgen.run(ck_dir, seed=17, slots=slots, grid=grid,
+                            cold_grid=12 if dry_run else 256,
+                            label=f"window-service-{grid}^3")
+    finally:
+        stop_scraper.set()
+        scraper_thread.join(timeout=5)
     wall_s = time.perf_counter() - t0
     led = PerfLedger.from_events(events_path,
                                  label=f"service-{grid}^3")
@@ -527,10 +590,20 @@ def worker_service(dry_run):
            warm_ttfs_p50_s=((sv.get("ttfs_s") or {})
                             .get("warm") or {}).get("p50_s"),
            warm_lease_backend_compiles=sv.get(
-               "warm_lease_backend_compiles"))
+               "warm_lease_backend_compiles"),
+           slo=stats.get("slo"),
+           live_port=live_port,
+           live_scrape=scrape or None,
+           alerts=led.alerts())
     ok = (stats.get("preempt_bitexact") is True
           and stats.get("lease_failures") == 0
-          and not sv.get("warm_lease_backend_compiles"))
+          and not sv.get("warm_lease_backend_compiles")
+          # the live plane half of the leg: the endpoint answered at
+          # least one mid-run scrape, and the seeded burn alert both
+          # fired and resolved in the same record
+          and bool(scrape.get("scrapes"))
+          and (stats.get("slo") or {}).get("alerts", 0) >= 1
+          and not (stats.get("slo") or {}).get("alerting"))
     return 0 if ok else 1
 
 

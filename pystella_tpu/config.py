@@ -244,6 +244,31 @@ register("PYSTELLA_SERVICE_PREEMPT", default="1", kind="bool",
               "chunk boundary (drain -> durable checkpoint -> "
               "requeue, no work lost); 0 runs every lease to "
               "completion")
+register("PYSTELLA_LIVE_PORT", default="0", kind="int",
+         help="TCP port of the opt-in in-process live telemetry "
+              "endpoint (obs.live: /metrics Prometheus exposition, "
+              "/healthz liveness+readiness, /slo burn-rate state), "
+              "bound to 127.0.0.1 on a daemon thread around "
+              "ScenarioService.serve(); 0 (default) or unset disables "
+              "the live plane entirely — emit paths and event logs "
+              "are then byte-identical to a build without it")
+register("PYSTELLA_SLO_FAST_WINDOW_S", default="60", kind="float",
+         help="fast window in seconds of the live SLO burn-rate "
+              "monitor (obs.slo.SLOMonitor): an alert fires only when "
+              "the windowed metric breaches its bar over BOTH the "
+              "fast window (it is still happening) and the slow "
+              "window (it is sustained), and resolves when the fast "
+              "window recovers or empties")
+register("PYSTELLA_SLO_SLOW_WINDOW_S", default="300", kind="float",
+         help="slow window in seconds of the live SLO burn-rate "
+              "monitor — the sustained-breach half of the fast/slow "
+              "multi-window alert rule")
+register("PYSTELLA_SLO_MIN_SAMPLES", default="1", kind="int",
+         help="minimum samples the fast window must hold before a "
+              "percentile/rate SLO leg may fire (count-kind legs are "
+              "exempt — their value IS the sample count); raise it on "
+              "a busy service so a single outlier dispatch cannot "
+              "page")
 register("PYSTELLA_TRACE_SERVICE", default="1", kind="bool",
          help="request-scoped distributed tracing in the scenario "
               "service: 1 (default) allocates a trace id per "

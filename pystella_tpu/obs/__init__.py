@@ -73,6 +73,27 @@ ledger's ``latency`` section and the gate's deadline-miss SLO consume
 it; :func:`~pystella_tpu.obs.events.registered_event_kinds` is the
 central emit vocabulary the source lint audits.
 
+The LIVE OPERATIONS PLANE (PR 14) is the other half of the
+production-telemetry split — everything above is post-hoc, while a
+persistent service needs scrape-time truth:
+
+- :mod:`pystella_tpu.obs.live` — an opt-in stdlib ``http.server``
+  endpoint on a daemon thread (``PYSTELLA_LIVE_PORT``, 0 = off):
+  ``/metrics`` Prometheus exposition of the metrics registry plus the
+  scenario service's live gauges (queue depth per class/tenant, active
+  leases, warm-pool fingerprint health, last-chunk member-steps/s),
+  ``/healthz`` liveness+readiness from the serve loop and supervisor
+  state, ``/slo`` the current burn-rate state.
+- :mod:`pystella_tpu.obs.slo` — a rolling-window SLO monitor fed by the
+  :meth:`EventLog.subscribe <pystella_tpu.obs.events.EventLog.
+  subscribe>` in-process push hook (not log tailing): queue-p95, warm
+  TTFS, deadline-miss rate, and incident rate as fast/slow multi-window
+  burn rates against the SAME factor+floor bars the gate uses, emitting
+  ``slo_alert``/``slo_resolved`` events so live alerts become
+  gate-visible evidence — the ledger's ``alerts`` section counts them
+  and the gate refuses an unresolved burn alert beside a green post-hoc
+  SLO section.
+
 See ``doc/observability.md`` for the event schema and driver recipes.
 """
 

@@ -238,6 +238,16 @@ for _name, _help in (
     ("deadline_missed", "a deadlined request retired after its deadline "
                         "(margin_s < 0)"),
     ("service_loadgen", "the synthetic-mix summary"),
+    # -- live operations plane (obs.live / obs.slo) -------------------------
+    ("live_serve", "the in-process telemetry endpoint came up "
+                   "(port, endpoints)"),
+    ("slo_alert", "a rolling-window SLO burn-rate alert FIRED "
+                  "(obs.slo.SLOMonitor; leg, windowed value, bar)"),
+    ("slo_resolved", "a burning SLO leg recovered below its bar "
+                     "(duration_s since the matching slo_alert)"),
+    ("obs_subscriber_error", "an EventLog emit subscriber raised; the "
+                             "emit path degraded it to this one-time "
+                             "event instead of breaking"),
     # -- driver-side kinds (bench.py / examples; outside the package, so
     # -- not lint-audited, but registered so the vocabulary is one list)
     ("bench_run", "bench payload run metadata"),
@@ -343,6 +353,9 @@ class EventLog:
         self._lock = threading.Lock()
         self._file = None
         self._warned = False
+        self._subscribers = []
+        self._subscriber_errored = False
+        self._notify_tls = threading.local()
         if rotate_bytes is None:
             # direct read (not config.getenv): this module must stay
             # loadable BY FILE in a jax-free supervisor, where the
@@ -414,13 +427,71 @@ class EventLog:
     def enabled(self):
         return self._file is not None
 
+    # -- subscribers: the in-process push channel (live SLO monitors) -------
+
+    def subscribe(self, fn):
+        """Register ``fn(record)`` to receive every emitted record
+        in-process, immediately after the write — the push channel the
+        live SLO monitor (:mod:`pystella_tpu.obs.slo`) rides instead of
+        tailing the log file. Subscribers survive size-triggered
+        rotation (they hang off the log object, not the file handle)
+        but NOT :func:`configure` (which builds a fresh log). A
+        subscriber that raises never breaks the emit path: the failure
+        degrades to a one-time ``obs_subscriber_error`` event and the
+        subscriber stays registered (the fault may be transient).
+        Returns ``fn`` so a lambda can be kept for :meth:`unsubscribe`.
+        """
+        if fn not in self._subscribers:
+            self._subscribers.append(fn)
+        return fn
+
+    def unsubscribe(self, fn):
+        """Remove a subscriber (idempotent)."""
+        try:
+            self._subscribers.remove(fn)
+        except ValueError:
+            pass
+
+    def _notify(self, rec):
+        """Push ``rec`` to subscribers, outside the write lock (a
+        subscriber may itself emit — e.g. the SLO monitor's
+        ``slo_alert``) and re-entrancy-guarded per thread: an emit made
+        FROM a subscriber callback is written normally but not pushed
+        again, so a monitor that emits alerts cannot recurse through
+        its own hook."""
+        if not self._subscribers:
+            return
+        if getattr(self._notify_tls, "active", False):
+            return
+        self._notify_tls.active = True
+        try:
+            for fn in list(self._subscribers):
+                try:
+                    fn(rec)
+                except Exception as e:  # noqa: BLE001 — never break emit
+                    if not self._subscriber_errored:
+                        self._subscriber_errored = True
+                        print("pystella_tpu.obs: event subscriber "
+                              f"{fn!r} raised ({type(e).__name__}: {e});"
+                              " telemetry continues without it",
+                              file=sys.stderr)
+                        self.emit("obs_subscriber_error",
+                                  subscriber=repr(fn),
+                                  error=f"{type(e).__name__}: {e}")
+        finally:
+            self._notify_tls.active = False
+
     def emit(self, kind, step=None, **data):
         """Append one event; returns the record dict (``None`` when
-        disabled or on a failed write — telemetry is best-effort by
-        design and must never kill the instrumented run). The ambient
-        :func:`tracing` context, when active on this thread, lands as
-        the v2 ``trace``/``span``/``parent`` fields."""
-        if self._file is None:  # cheap pre-check; re-read under the lock
+        nothing consumed it: a disabled, subscriber-less sink, or a
+        failed write — telemetry is best-effort by design and must
+        never kill the instrumented run). The ambient :func:`tracing`
+        context, when active on this thread, lands as the v2
+        ``trace``/``span``/``parent`` fields. Registered subscribers
+        (:meth:`subscribe`) receive the record after the write — also
+        on a file-less sink, so a live monitor works without a log."""
+        if self._file is None and not self._subscribers:
+            # cheap pre-check; file re-read under the lock
             return None
         rec = {"v": SCHEMA_VERSION, "ts": time.time(),
                "mono": time.monotonic(),
@@ -433,24 +504,26 @@ class EventLog:
             for key in ("trace", "span", "parent"):
                 if ctx.get(key) is not None:
                     rec[key] = ctx[key]
-        line = json.dumps(rec)
-        with self._lock:
-            f = self._file  # may have been closed/reconfigured since
-            if f is None:
-                return None
-            try:
-                f.write(line + "\n")
-                f.flush()
-            except (OSError, ValueError) as e:  # ENOSPC, closed file, ...
-                if not self._warned:
-                    self._warned = True
-                    print(f"pystella_tpu.obs: event log write failed "
-                          f"({e}); further events may be lost",
-                          file=sys.stderr)
-                return None
-            if self.rotate_bytes:
-                self._maybe_rotate()
-        return rec
+        written = False
+        if self._file is not None:
+            line = json.dumps(rec)
+            with self._lock:
+                f = self._file  # may have been closed/reconfigured since
+                if f is not None:
+                    try:
+                        f.write(line + "\n")
+                        f.flush()
+                        written = True
+                    except (OSError, ValueError) as e:  # ENOSPC, ...
+                        if not self._warned:
+                            self._warned = True
+                            print("pystella_tpu.obs: event log write "
+                                  f"failed ({e}); further events may "
+                                  "be lost", file=sys.stderr)
+                    if written and self.rotate_bytes:
+                        self._maybe_rotate()
+        self._notify(rec)
+        return rec if (written or self._subscribers) else None
 
     def close(self):
         with self._lock:

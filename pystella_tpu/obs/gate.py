@@ -38,7 +38,11 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       fingerprints (the leases did not dispatch the programs the
       admission contract names), the report claims fewer incidents
       than its ``resilience`` event record carries (a clean headline
-      over a degraded fleet), or baseline and current were measured on
+      over a degraded fleet), the report's ``alerts`` section carries a
+      live burn alert UNRESOLVED at exit while the matching post-hoc
+      SLO section claims green (the live and post-hoc halves
+      contradict; ``--no-alerts`` opts out, alert-FLAP growth merely
+      warns), or baseline and current were measured on
       different hardware. Exception: a
       run that recorded AND recovered REAL (non-harness-injected)
       incidents (``resilience`` section,
@@ -223,7 +227,7 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     service_ttfs_factor=2.5,
                     service_ttfs_floor_s=1.0,
                     check_latency=True, latency_miss_factor=2.0,
-                    latency_miss_floor=0.05):
+                    latency_miss_floor=0.05, check_alerts=True):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -672,7 +676,81 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
             "resilience: baseline carried a resilience section but the "
             "current run has none — incident/checkpoint coverage was "
             "lost")
+    if check_alerts:
+        _check_alerts(verdict, baseline, current)
     return verdict
+
+
+def _check_alerts(verdict, baseline, current):
+    """Live-alert consistency audit (mutates ``verdict`` in place; runs
+    AFTER the post-hoc SLO comparisons because it needs their
+    outcomes). The ``alerts`` report section
+    (:mod:`pystella_tpu.obs.slo` via the ledger) is the live half of
+    each SLO; the post-hoc sections are the other. The two must agree:
+
+    - an **unresolved-at-exit burn alert** for a leg whose post-hoc
+      verdict came out GREEN is a live/post-hoc contradiction — the
+      monitor watched the SLO burn until the record ended while the
+      report claims the SLO held, so one of them is wrong and the
+      evidence proves nothing either way: invalid evidence, exit 2
+      (``--no-alerts`` opts out). An unresolved alert whose post-hoc
+      leg ALSO failed is consistent (the gate already failed; the
+      alert is corroboration, noted as a warning).
+    - **alert-flap growth** (more fire→resolve→fire churn than the
+      baseline recorded) warns: a flapping SLO is a bar sitting on the
+      noise floor or a service oscillating around saturation — either
+      deserves an operator before it deserves a page.
+    - lost coverage (baseline carried an ``alerts`` section, current
+      does not) warns like every other section."""
+    cal = current.get("alerts") or {}
+    bal = (baseline or {}).get("alerts") or {}
+    if bal and not cal:
+        verdict["warnings"].append(
+            "alerts: baseline carried a live-alert (SLO burn) section "
+            "but the current run has none — live SLO coverage was "
+            "lost; attach the SLOMonitor (obs.slo)")
+        return
+    if not cal:
+        return
+    reasons = verdict.get("reasons") or []
+    # which post-hoc legs came out green (no failing reason / no
+    # recorded incidents)? keyed by the monitor's leg names
+    post_hoc_green = {
+        "queue_p95": not any("queue-latency p95" in r for r in reasons),
+        "warm_ttfs": not any("warm time-to-first-step" in r
+                             for r in reasons),
+        "deadline_miss": not any("deadline-miss SLO regression" in r
+                                 for r in reasons),
+        "incident_rate": not (current.get("resilience")
+                              or {}).get("n_incidents"),
+    }
+    for rec in cal.get("unresolved") or []:
+        leg = str(rec.get("leg"))
+        if post_hoc_green.get(leg, True):
+            verdict.update(ok=False, exit_code=2)
+            verdict["reasons"].append(
+                f"invalid_evidence: live burn alert {leg!r} was still "
+                f"firing when the run record ended (value "
+                f"{rec.get('value')} vs bar {rec.get('bar')}) but the "
+                "post-hoc SLO section claims green — the live and "
+                "post-hoc halves contradict; trust neither")
+        else:
+            verdict["warnings"].append(
+                f"alerts: unresolved live burn alert {leg!r} "
+                "corroborates the failed post-hoc verdict for the "
+                "same SLO")
+    b_flaps = bal.get("flaps")
+    c_flaps = cal.get("flaps")
+    if isinstance(b_flaps, int) and isinstance(c_flaps, int) \
+            and c_flaps > b_flaps:
+        verdict["warnings"].append(
+            f"alerts: {c_flaps} alert flap(s) vs {b_flaps} in the "
+            "baseline — an SLO oscillating around its bar; check the "
+            "report's alerts section before trusting either verdict")
+    verdict["alerts"] = {
+        "alerts": cal.get("alerts"), "resolved": cal.get("resolved"),
+        "flaps": c_flaps, "unresolved": len(cal.get("unresolved") or []),
+    }
 
 
 def _compare_fft(verdict, baseline, current, threshold_pct=25.0):
@@ -1112,6 +1190,11 @@ def main(argv=None):
                    help="skip the request-latency checks (deadline-"
                         "miss SLO regression, span-assembly coverage "
                         "warnings)")
+    p.add_argument("--no-alerts", action="store_true",
+                   help="skip the live-alert consistency audit (an "
+                        "unresolved burn alert beside a green post-hoc "
+                        "SLO section refuses the evidence; alert-flap "
+                        "growth warns)")
     p.add_argument("--no-resilience", action="store_true",
                    help="skip the resilience triage (degraded-fleet "
                         "annotation of regressions/contamination across "
@@ -1178,7 +1261,8 @@ def main(argv=None):
         service_ttfs_floor_s=args.service_ttfs_floor,
         check_latency=not args.no_latency,
         latency_miss_factor=args.latency_miss_factor,
-        latency_miss_floor=args.latency_miss_floor)
+        latency_miss_floor=args.latency_miss_floor,
+        check_alerts=not args.no_alerts)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

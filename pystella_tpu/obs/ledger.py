@@ -270,6 +270,9 @@ class PerfLedger:
         #                                 (obs schema v2) — the latency
         #                                 section's SpanAssembler input
         self.deadline_miss_events = 0   # deadline_missed events
+        self.slo_events = []            # ("alert"|"resolved", ts, data)
+        #                                 from the live burn-rate
+        #                                 monitor (obs.slo) -> alerts()
 
     # -- ingestion ---------------------------------------------------------
 
@@ -457,6 +460,10 @@ class PerfLedger:
                 led.service_done = data
             elif kind == "service_loadgen":
                 led.service_loadgen = data
+            elif kind == "slo_alert":
+                led.slo_events.append(("alert", ev.get("ts"), data))
+            elif kind == "slo_resolved":
+                led.slo_events.append(("resolved", ev.get("ts"), data))
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -1118,6 +1125,64 @@ class PerfLedger:
                           "preempt_bitexact")}
         return out
 
+    def alerts(self):
+        """The live-alert summary (:mod:`pystella_tpu.obs.slo` burn-rate
+        monitor): per-leg alert/resolve counts, flaps (re-fires after a
+        resolve), total and max alert durations, and — the field the
+        gate audits — ``unresolved``: alerts still burning when the run
+        record ends. An unresolved burn alert beside a post-hoc SLO
+        section that claims green is the live/post-hoc contradiction
+        the gate refuses as invalid evidence (exit 2). ``None`` when
+        the run carried no live SLO telemetry at all (monitor not
+        attached — coverage the gate warns about when the baseline had
+        it)."""
+        if not self.slo_events:
+            return None
+        by_leg = {}
+
+        def row(leg):
+            return by_leg.setdefault(str(leg), {
+                "alerts": 0, "resolved": 0, "flaps": 0,
+                "total_alert_s": 0.0, "max_alert_s": None,
+                "open": None})
+
+        for kind, ts, data in self.slo_events:
+            r = row(data.get("leg"))
+            if kind == "alert":
+                r["alerts"] += 1
+                r["flaps"] = max(0, r["alerts"] - 1)
+                r["open"] = {"since_ts": ts,
+                             "value": data.get("value"),
+                             "bar": data.get("bar"),
+                             "burn_fast": data.get("burn_fast"),
+                             "burn_slow": data.get("burn_slow")}
+            else:
+                r["resolved"] += 1
+                d = data.get("duration_s")
+                if d is None and r["open"] is not None \
+                        and isinstance(ts, (int, float)) \
+                        and isinstance(r["open"].get("since_ts"),
+                                       (int, float)):
+                    d = ts - r["open"]["since_ts"]
+                if isinstance(d, (int, float)):
+                    r["total_alert_s"] += float(d)
+                    r["max_alert_s"] = (float(d)
+                                        if r["max_alert_s"] is None
+                                        else max(r["max_alert_s"],
+                                                 float(d)))
+                r["open"] = None
+        unresolved = [{"leg": leg, **r["open"]}
+                      for leg, r in sorted(by_leg.items())
+                      if r["open"] is not None]
+        return {
+            "alerts": sum(r["alerts"] for r in by_leg.values()),
+            "resolved": sum(r["resolved"] for r in by_leg.values()),
+            "flaps": sum(r["flaps"] for r in by_leg.values()),
+            "unresolved": unresolved,
+            "by_leg": {leg: {k: v for k, v in r.items() if k != "open"}
+                       for leg, r in sorted(by_leg.items())},
+        }
+
     def latency(self):
         """Request-scoped critical-path latency attribution
         (:mod:`pystella_tpu.obs.spans` over the schema-v2 trace
@@ -1217,6 +1282,7 @@ class PerfLedger:
             "fft": self.fft(),
             "service": self.service(),
             "latency": self.latency(),
+            "alerts": self.alerts(),
             "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
@@ -1678,6 +1744,28 @@ def render_markdown(rep):
                     f"  - class {cls}: {row.get('missed')}/"
                     f"{row.get('deadlined')} missed "
                     f"({_fmt(row.get('miss_rate'), '.0%')})")
+        lines.append("")
+    al = rep.get("alerts")
+    if al:
+        lines += ["## SLO alerts (live burn-rate monitor)", ""]
+        lines.append(
+            f"- {_fmt(al.get('alerts'), '.0f', '0')} alert(s) fired, "
+            f"{_fmt(al.get('resolved'), '.0f', '0')} resolved, "
+            f"{_fmt(al.get('flaps'), '.0f', '0')} flap(s) "
+            "(re-fires after a resolve)")
+        for rec in al.get("unresolved") or []:
+            lines.append(
+                f"- **UNRESOLVED at exit**: `{rec.get('leg')}` burning "
+                f"at {_fmt(rec.get('value'))} vs bar "
+                f"{_fmt(rec.get('bar'))} — the gate refuses this "
+                "report if its post-hoc SLO section claims green")
+        for leg, r in sorted((al.get("by_leg") or {}).items()):
+            lines.append(
+                f"  - `{leg}`: {r.get('alerts')} fired / "
+                f"{r.get('resolved')} resolved, total "
+                f"{_fmt(r.get('total_alert_s'))} s alerting"
+                + (f" (max {_fmt(r.get('max_alert_s'))} s)"
+                   if r.get("max_alert_s") is not None else ""))
         lines.append("")
     ff = rep.get("fft")
     if ff:

@@ -1,0 +1,305 @@
+"""In-process live telemetry endpoint: ``/metrics``, ``/healthz``, ``/slo``.
+
+Every observability layer before this one is post-hoc — events land in
+JSONL and the ledger/gate turn them into verdicts after the run. A
+persistent scenario service needs the other half of the standard
+production-telemetry split: a scrape endpoint an operator (or a
+Prometheus collector) can hit *while the server is serving*. This
+module is that half, stdlib-only by design (``http.server`` on a
+daemon thread — the serving path must not grow a dependency):
+
+- ``GET /metrics`` — Prometheus text exposition (version 0.0.4)
+  rendered from :meth:`MetricsRegistry.snapshot_typed
+  <pystella_tpu.obs.metrics.MetricsRegistry.snapshot_typed>` (every
+  registry counter/gauge/timer, ``pystella_``-prefixed, dots folded to
+  underscores) plus the service gauges computed fresh per scrape from
+  :meth:`ScenarioService.live_status
+  <pystella_tpu.service.ScenarioService.live_status>`: queue depth per
+  priority class and per tenant, active leases, warm-pool entries by
+  fingerprint match, and the last chunk's member-steps/s.
+- ``GET /healthz`` — liveness + readiness JSON derived from the serve
+  loop and supervisor state (``serving``, the active lease and whether
+  its supervisor is draining, queue depth, uptime). Bare ``/healthz``
+  answers 200 whenever the process is alive (the liveness probe);
+  ``/healthz?ready`` keys the status code on readiness instead (503
+  while the serve loop is not running), so status-code-only probers
+  cover both.
+- ``GET /slo`` — the current burn-rate state of the attached
+  :class:`~pystella_tpu.obs.slo.SLOMonitor` as JSON (the monitor is
+  re-evaluated per scrape, so aging-out resolution is visible without
+  waiting for the next event).
+
+Opt-in: :func:`start_from_env` reads the registered
+``PYSTELLA_LIVE_PORT`` (0/unset = off — the default; the live plane
+must cost nothing when disabled) and binds 127.0.0.1 only — this is an
+operator loopback/sidecar endpoint, not a public listener. The
+scenario service calls it around :meth:`serve
+<pystella_tpu.service.ScenarioService.serve>`; a driver can also run
+one standalone around any instrumented loop::
+
+    from pystella_tpu.obs import live
+    server = live.LiveServer(service=svc, slo=monitor)  # ephemeral port
+    server.start()
+    print(server.url("/metrics"))
+    ...
+    server.close()
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import metrics as _metrics
+
+__all__ = ["LiveServer", "render_prometheus", "start_from_env"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(key):
+    return "pystella_" + _NAME_RE.sub("_", str(key))
+
+
+def _prom_label(value):
+    """Escape a label value per the Prometheus text format (backslash,
+    double quote, newline) — tenant names are arbitrary caller strings
+    and must not be able to break, or inject lines into, the
+    exposition."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_value(v):
+    if v is None or (isinstance(v, float) and math.isnan(v)):
+        return "NaN"
+    return format(float(v), ".10g")
+
+
+def render_prometheus(registry=None, status=None):
+    """The ``/metrics`` body: the registry's typed snapshot plus the
+    service-status gauges, Prometheus text format. Pure function of its
+    inputs so the exposition is testable without a socket."""
+    reg = registry if registry is not None else _metrics.registry()
+    lines = []
+
+    def metric(name, kind, value, labels=None, help=None):
+        if help:
+            lines.append(f"# HELP {name} {help}")
+        lines.append(f"# TYPE {name} {kind}")
+        tag = ("{" + ",".join(f'{k}="{_prom_label(v)}"'
+                              for k, v in sorted(labels.items())) + "}"
+               if labels else "")
+        lines.append(f"{name}{tag} {_prom_value(value)}")
+
+    for key, (value, kind) in reg.snapshot_typed().items():
+        metric(_prom_name(key), kind, value)
+
+    if status:
+        by_class = status.get("queue_by_priority") or {}
+        by_tenant = status.get("queue_by_tenant") or {}
+        name = "pystella_service_queue_depth"
+        lines.append(f"# HELP {name} queued requests (per priority "
+                     "class / tenant; overall unlabeled)")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} "
+                     f"{_prom_value(status.get('queue_depth'))}")
+        for cls, n in sorted(by_class.items()):
+            lines.append(f'{name}{{priority="{_prom_label(cls)}"}} '
+                         f"{_prom_value(n)}")
+        for tenant, n in sorted(by_tenant.items()):
+            lines.append(f'{name}{{tenant="{_prom_label(tenant)}"}} '
+                         f"{_prom_value(n)}")
+        metric("pystella_service_active_leases", "gauge",
+               status.get("active_leases"),
+               help="leases currently holding requests")
+        pool = status.get("warm_pool") or {}
+        name = "pystella_service_warm_pool_entries"
+        lines.append(f"# HELP {name} armed warm-pool entries by live "
+                     "fingerprint match")
+        lines.append(f"# TYPE {name} gauge")
+        for match in ("ok", "stale"):
+            lines.append(f'{name}{{fingerprint="{match}"}} '
+                         f"{_prom_value(pool.get(match, 0))}")
+        metric("pystella_service_last_chunk_member_steps_per_s",
+               "gauge", status.get("last_chunk_member_steps_per_s"),
+               help="member-steps/s of the most recent batched chunk")
+        metric("pystella_service_serving", "gauge",
+               1.0 if status.get("serving") else 0.0,
+               help="1 while the serve loop is draining the queue")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server_version/sys_version default header leaks stdlib
+    # versions; keep the surface anonymous and quiet
+    server_version = "pystella-live"
+    sys_version = ""
+
+    def log_message(self, *args):  # no stderr chatter per scrape
+        pass
+
+    def _send(self, code, body, content_type):
+        payload = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):  # noqa: N802 — http.server's contract
+        live = self.server.live
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(200, live.metrics_text(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                body = live.healthz()
+                # bare /healthz is the LIVENESS probe: answering at all
+                # means alive -> 200. /healthz?ready keys the status
+                # code on readiness (the serve loop running), so a
+                # status-code-only readiness prober works too.
+                code = 200
+                if "ready" in query and not body.get("ready"):
+                    code = 503
+                self._send(code, json.dumps(body, sort_keys=True),
+                           "application/json")
+            elif path == "/slo":
+                self._send(200, json.dumps(live.slo_state(),
+                                           sort_keys=True, default=str),
+                           "application/json")
+            else:
+                self._send(404, json.dumps(
+                    {"error": "unknown path",
+                     "endpoints": ["/metrics", "/healthz", "/slo"]}),
+                    "application/json")
+        except Exception as e:  # noqa: BLE001 — a scrape must not kill it
+            self._send(500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}),
+                "application/json")
+
+
+class LiveServer:
+    """The telemetry endpoint on a daemon thread (module docstring).
+
+    :arg port: TCP port on 127.0.0.1; ``None`` binds an ephemeral port
+        (tests, sidecars that read :attr:`port` back).
+    :arg service: optional :class:`~pystella_tpu.service.
+        ScenarioService` (anything with a ``live_status()`` -> dict) —
+        feeds the service gauges and the readiness fields.
+    :arg slo: optional :class:`~pystella_tpu.obs.slo.SLOMonitor` for
+        ``/slo`` (re-evaluated per scrape).
+    :arg registry: metrics registry override (default: the process
+        registry).
+    :arg label: tag on the ``live_serve`` event.
+    """
+
+    def __init__(self, port=None, service=None, slo=None, registry=None,
+                 label="live"):
+        self.service = service
+        self.slo = slo
+        self.registry = registry
+        self.label = str(label)
+        self._t0 = time.time()
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", int(port) if port else 0), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.live = self
+        self.port = int(self._httpd.server_port)
+        self._thread = None
+
+    # -- payloads (also the test seam: no socket required) ------------------
+
+    def metrics_text(self):
+        status = None
+        if self.service is not None:
+            status = self.service.live_status()
+        return render_prometheus(registry=self.registry, status=status)
+
+    def healthz(self):
+        out = {"ok": True, "alive": True, "ts": time.time(),
+               "uptime_s": round(time.time() - self._t0, 3),
+               "port": self.port, "label": self.label,
+               "ready": True}
+        if self.service is not None:
+            status = self.service.live_status()
+            out.update({
+                "ready": bool(status.get("serving")),
+                "serving": status.get("serving"),
+                "queue_depth": status.get("queue_depth"),
+                "active_lease": status.get("active_lease"),
+                "supervisor": status.get("supervisor"),
+                "leases_completed": status.get("leases_completed"),
+            })
+        if self.slo is not None:
+            out["slo_alerting"] = self.slo.state()["alerting"]
+        return out
+
+    def slo_state(self):
+        if self.slo is None:
+            return {"enabled": False}
+        self.slo.evaluate()
+        return {"enabled": True, **self.slo.state()}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """Serve on a daemon thread; returns ``self``. Emits a
+        ``live_serve`` event so the run record shows the endpoint (and
+        its port) was up."""
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name=f"pystella-live:{self.port}", daemon=True)
+            self._thread.start()
+            _events.emit("live_serve", port=self.port,
+                         endpoints=["/metrics", "/healthz", "/slo"],
+                         label=self.label)
+        return self
+
+    def url(self, path="/"):
+        return f"http://127.0.0.1:{self.port}{path}"
+
+    def close(self):
+        """Stop serving and release the port (idempotent)."""
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def start_from_env(service=None, slo=None, registry=None, label="live"):
+    """Start a :class:`LiveServer` when the registered
+    ``PYSTELLA_LIVE_PORT`` names a port; return ``None`` when it is
+    0/unset (the live plane is strictly opt-in). A port that cannot be
+    bound degrades to ``None`` with a stderr warning — live telemetry
+    must never kill the serving process."""
+    port = _config.get_int("PYSTELLA_LIVE_PORT") or 0
+    if port <= 0:
+        return None
+    try:
+        return LiveServer(port=port, service=service, slo=slo,
+                          registry=registry, label=label).start()
+    except (OSError, OverflowError, ValueError) as e:
+        # OSError: port in use / no permission; OverflowError: a port
+        # outside 0-65535 (socket.bind raises it, NOT OSError)
+        import sys
+        print(f"pystella_tpu.obs.live: cannot bind port {port} ({e}); "
+              "live endpoint disabled for this run", file=sys.stderr)
+        return None

@@ -20,6 +20,15 @@ one chip) it degrades to the local snapshot. Counting caveat: counters
 incremented inside jit-traced code count *traces*, not executions —
 increment from host-level entry points (``step()``, the cycle driver)
 for true counts; traced increments are a static proxy only.
+
+Thread-safety contract (the live telemetry endpoint scrapes
+:meth:`MetricsRegistry.snapshot` from its own daemon thread while the
+serve loop updates): every metric a registry creates shares the
+registry's re-entrant lock, each update (``inc``/``set``/``observe``)
+is one atomic section under it, and ``snapshot`` holds the same lock
+across ALL exports — a scrape can never observe a Timer between its
+``count`` bump and its ``total_s`` accumulation, or a half-updated
+EMA. A metric constructed standalone gets its own lock.
 """
 
 from __future__ import annotations
@@ -38,35 +47,49 @@ _REDUCERS = {"sum": np.sum, "mean": np.mean, "max": np.max, "min": np.min}
 class Counter:
     """Monotonic event count; cross-host reduction: sum."""
 
-    def __init__(self, name):
+    def __init__(self, name, _lock=None):
         self.name = name
         self.value = 0
+        self._lock = _lock if _lock is not None else threading.RLock()
 
     def inc(self, n=1):
-        self.value += n
-        return self.value
+        with self._lock:
+            self.value += n
+            return self.value
 
     def export(self):
-        return {self.name: (float(self.value), "sum")}
+        with self._lock:
+            return {self.name: (float(self.value), "sum")}
+
+    def export_typed(self):
+        with self._lock:
+            return {self.name: (float(self.value), "counter")}
 
 
 class Gauge:
     """Last-set value; cross-host reduction per ``reduce``."""
 
-    def __init__(self, name, reduce="mean"):
+    def __init__(self, name, reduce="mean", _lock=None):
         if reduce not in _REDUCERS:
             raise ValueError(f"unknown reduction {reduce!r}; "
                              f"choose from {sorted(_REDUCERS)}")
         self.name = name
         self.reduce = reduce
         self.value = float("nan")
+        self._lock = _lock if _lock is not None else threading.RLock()
 
     def set(self, value):
-        self.value = float(value)
-        return self.value
+        with self._lock:
+            self.value = float(value)
+            return self.value
 
     def export(self):
-        return {self.name: (self.value, self.reduce)}
+        with self._lock:
+            return {self.name: (self.value, self.reduce)}
+
+    def export_typed(self):
+        with self._lock:
+            return {self.name: (self.value, "gauge")}
 
 
 class Timer:
@@ -76,21 +99,23 @@ class Timer:
     feed observed seconds via :meth:`observe`.
     """
 
-    def __init__(self, name, ema_alpha=0.2):
+    def __init__(self, name, ema_alpha=0.2, _lock=None):
         self.name = name
         self.ema_alpha = float(ema_alpha)
         self.count = 0
         self.total_s = 0.0
         self.ema_ms = float("nan")
+        self._lock = _lock if _lock is not None else threading.RLock()
 
     def observe(self, seconds):
-        self.count += 1
-        self.total_s += seconds
-        ms = seconds * 1e3
-        self.ema_ms = (ms if self.count == 1 else
-                       self.ema_alpha * ms
-                       + (1.0 - self.ema_alpha) * self.ema_ms)
-        return self.ema_ms
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            ms = seconds * 1e3
+            self.ema_ms = (ms if self.count == 1 else
+                           self.ema_alpha * ms
+                           + (1.0 - self.ema_alpha) * self.ema_ms)
+            return self.ema_ms
 
     def __enter__(self):
         self._t0 = time.perf_counter()
@@ -100,9 +125,16 @@ class Timer:
         self.observe(time.perf_counter() - self._t0)
 
     def export(self):
-        return {f"{self.name}.count": (float(self.count), "sum"),
-                f"{self.name}.total_s": (self.total_s, "sum"),
-                f"{self.name}.ema_ms": (self.ema_ms, "mean")}
+        with self._lock:
+            return {f"{self.name}.count": (float(self.count), "sum"),
+                    f"{self.name}.total_s": (self.total_s, "sum"),
+                    f"{self.name}.ema_ms": (self.ema_ms, "mean")}
+
+    def export_typed(self):
+        with self._lock:
+            return {f"{self.name}.count": (float(self.count), "counter"),
+                    f"{self.name}.total_s": (self.total_s, "counter"),
+                    f"{self.name}.ema_ms": (self.ema_ms, "gauge")}
 
 
 class MetricsRegistry:
@@ -112,7 +144,10 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics = {}
-        self._lock = threading.Lock()
+        # re-entrant: _exports holds it while each metric's export()
+        # re-enters; metrics created here share it so an update and a
+        # snapshot serialize against each other (module docstring)
+        self._lock = threading.RLock()
 
     def _get(self, name, factory, cls):
         with self._lock:
@@ -126,13 +161,19 @@ class MetricsRegistry:
             return m
 
     def counter(self, name):
-        return self._get(name, lambda: Counter(name), Counter)
+        return self._get(name, lambda: Counter(name, _lock=self._lock),
+                         Counter)
 
     def gauge(self, name, reduce="mean"):
-        return self._get(name, lambda: Gauge(name, reduce), Gauge)
+        return self._get(name,
+                         lambda: Gauge(name, reduce, _lock=self._lock),
+                         Gauge)
 
     def timer(self, name, ema_alpha=0.2):
-        return self._get(name, lambda: Timer(name, ema_alpha), Timer)
+        return self._get(name,
+                         lambda: Timer(name, ema_alpha,
+                                       _lock=self._lock),
+                         Timer)
 
     def reset(self):
         with self._lock:
@@ -140,21 +181,32 @@ class MetricsRegistry:
 
     # -- snapshots and aggregation ----------------------------------------
 
-    def _exports(self):
+    def _exports(self, typed=False):
         """Sorted flat exports ``{key: (value, reduce_op)}`` — sorted so
         every host's snapshot vector lines up positionally for the
         cross-host gather (all hosts must register the same metrics,
-        which lockstep SPMD drivers do by construction)."""
+        which lockstep SPMD drivers do by construction). Held under the
+        registry lock end to end, so the whole vector is one consistent
+        cut even while another thread updates (the scrape-vs-serve-loop
+        race the live endpoint's thread-safety pin covers)."""
         with self._lock:
-            metrics = list(self._metrics.values())
-        flat = {}
-        for m in metrics:
-            flat.update(m.export())
+            flat = {}
+            for m in self._metrics.values():
+                flat.update(m.export_typed() if typed else m.export())
         return dict(sorted(flat.items()))
 
     def snapshot(self):
-        """Local values as ``{name: float}`` (sorted by name)."""
+        """Local values as ``{name: float}`` (sorted by name); one
+        consistent cut under the registry lock (module docstring)."""
         return {k: v for k, (v, _) in self._exports().items()}
+
+    def snapshot_typed(self):
+        """Local values as ``{name: (float, prom_kind)}`` where
+        ``prom_kind`` is the Prometheus exposition type (``counter`` /
+        ``gauge``) — what :func:`pystella_tpu.obs.live.
+        render_prometheus` renders. Same consistency guarantee as
+        :meth:`snapshot`."""
+        return self._exports(typed=True)
 
     def reduce_snapshots(self, snapshots):
         """Reduce a sequence of per-host ``{name: value}`` snapshots

@@ -1,0 +1,346 @@
+"""Rolling-window SLO burn-rate monitor over the live event stream.
+
+The post-hoc half of the SLO story lives in :mod:`pystella_tpu.obs.
+ledger` + :mod:`pystella_tpu.obs.gate`: after the run, the report's
+``service``/``latency`` sections are compared against a baseline with
+factor+floor bars. This module is the LIVE half: an
+:class:`SLOMonitor` subscribes to :meth:`EventLog.emit
+<pystella_tpu.obs.events.EventLog.subscribe>` (in-process push, not log
+tailing) and evaluates the same metrics as rolling windows *while the
+server is serving*, so an operator — or the ``/slo`` endpoint of
+:mod:`pystella_tpu.obs.live` — sees SLO burn before retire time.
+
+**Legs** (each maps 1:1 to a gate verdict, ``doc/service.md`` has the
+runbook table):
+
+==================  =====================================================
+leg                 windowed value (source events)
+==================  =====================================================
+``queue_p95``       p95 of ``service_dispatch.queue_latency_s``
+``warm_ttfs``       p50 of warm ``service_lease.ttfs_s``
+``deadline_miss``   miss fraction over ``member_result`` deadline
+                    verdicts (``deadline_missed``)
+``incident_rate``   count of ``fault_detected`` events in the window
+==================  =====================================================
+
+**Bars.** Each leg's alert bar is built from an *objective* with the
+SAME factor+floor arithmetic the gate applies to its baseline:
+``bar = max(objective * factor, objective + floor)`` — the gate fails a
+report when ``current > baseline * factor AND current - baseline >
+floor``, and a windowed value above this bar is exactly a live sample
+of that verdict. Defaults reuse the gate's knob defaults (queue 2.5× /
+0.5 s, TTFS 2.5× / 1 s, deadline-miss 2× / 0.05, incidents bar 0 —
+any detected fault burns until it ages out).
+
+**Multi-window burn.** The standard fast/slow split: the breach must
+hold over BOTH the fast window (``PYSTELLA_SLO_FAST_WINDOW_S``, it is
+still happening) and the slow window (``PYSTELLA_SLO_SLOW_WINDOW_S``,
+it is sustained, not one blip) before ``slo_alert`` fires; the alert
+resolves (``slo_resolved``) when the fast window recovers below the
+bar — or empties, aging the offending samples out. Both events are
+registered kinds and land in the run record, so live alerts become
+gate-visible evidence: the ledger's ``alerts`` section counts them and
+the gate refuses a report whose unresolved burn alert contradicts a
+green post-hoc SLO section (``--no-alerts`` opts out).
+
+A leg spec may set ``window_samples`` to cap both windows at the last
+N samples — the seeded smoke configuration
+(:mod:`pystella_tpu.service.loadgen`) uses ``window_samples=1`` on the
+deadline leg so the one guaranteed miss fires the alert and the next
+guaranteed hit resolves it, deterministically, inside a seconds-long
+run.
+
+Usage (the scenario service wires this up itself when
+``PYSTELLA_LIVE_PORT`` is on, or accepts an explicit monitor)::
+
+    from pystella_tpu.obs import events, slo
+    monitor = slo.SLOMonitor()
+    events.get_log().subscribe(monitor.handle)
+    ...serve...
+    events.get_log().unsubscribe(monitor.handle)
+    monitor.state()     # the /slo payload
+
+The ingest path is a few dict lookups and a deque append — the
+monitor tracks its own cumulative ``ingest_s`` so the emit-path
+overhead is itself an auditable number (the smoke e2e pins it < 2% of
+the serve wall).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs.ledger import percentile as _percentile
+
+__all__ = ["DEFAULT_LEGS", "SLOMonitor", "leg_bar"]
+
+#: per-leg defaults: the objective each windowed value is held to and
+#: the gate's factor+floor bars (obs.gate.compare_reports defaults for
+#: the matching verdict). ``kind`` picks the windowed statistic.
+DEFAULT_LEGS = {
+    "queue_p95": {"objective": 0.0, "factor": 2.5, "floor": 0.5,
+                  "kind": "p95"},
+    "warm_ttfs": {"objective": 0.0, "factor": 2.5, "floor": 1.0,
+                  "kind": "p50"},
+    "deadline_miss": {"objective": 0.0, "factor": 2.0, "floor": 0.05,
+                      "kind": "rate"},
+    "incident_rate": {"objective": 0.0, "factor": 1.0, "floor": 0.0,
+                      "kind": "count"},
+}
+
+#: bounded per-leg sample memory — a monitor on a weeks-lived server
+#: must not grow without bound even with generous windows
+_MAX_SAMPLES = 4096
+
+
+def leg_bar(objective, factor, floor):
+    """The alert bar for one leg: the gate fails when ``current >
+    baseline * factor AND current - baseline > floor``, so the live
+    bar over objective ``b`` is ``max(b * factor, b + floor)`` — the
+    smallest value that would fail both gate conditions."""
+    objective = float(objective)
+    return max(objective * float(factor), objective + float(floor))
+
+
+def _window_value(kind, samples):
+    """The windowed statistic over ``[(ts, value), ...]`` samples."""
+    if kind == "count":
+        return float(len(samples))
+    if not samples:
+        return None
+    vals = sorted(v for _, v in samples)
+    if kind == "p95":
+        return _percentile(vals, 95)
+    if kind == "p50":
+        return _percentile(vals, 50)
+    if kind == "rate":
+        return sum(vals) / len(vals)
+    raise ValueError(f"unknown window kind {kind!r}")
+
+
+class _LegState:
+    """One leg's rolling samples and alert state machine."""
+
+    def __init__(self, name, spec, fast_s, slow_s, min_samples):
+        self.name = name
+        self.objective = float(spec.get("objective", 0.0))
+        self.factor = float(spec.get("factor", 1.0))
+        self.floor = float(spec.get("floor", 0.0))
+        self.kind = spec.get("kind", "rate")
+        self.fast_s = float(spec.get("fast_window_s", fast_s))
+        self.slow_s = float(spec.get("slow_window_s", slow_s))
+        self.min_samples = int(spec.get("min_samples", min_samples))
+        ws = spec.get("window_samples")
+        maxlen = min(_MAX_SAMPLES, int(ws)) if ws else _MAX_SAMPLES
+        self.samples = collections.deque(maxlen=maxlen)
+        self.bar = leg_bar(self.objective, self.factor, self.floor)
+        self.alerting = False
+        self.fired_ts = None
+        self.alerts = 0
+        self.resolved = 0
+        self.total_alert_s = 0.0
+        self.last = {}
+
+    def add(self, ts, value):
+        self.samples.append((float(ts), float(value)))
+
+    def evaluate(self, now):
+        """Windowed values + the fire/resolve transition (if any);
+        returns ``"fired"`` / ``"resolved"`` / ``None``."""
+        while self.samples and self.samples[0][0] < now - self.slow_s:
+            self.samples.popleft()
+        slow = list(self.samples)
+        fast = [s for s in slow if s[0] >= now - self.fast_s]
+        v_fast = _window_value(self.kind, fast)
+        v_slow = _window_value(self.kind, slow)
+        burn = (lambda v: None if v is None else
+                (v / self.bar if self.bar > 0 else
+                 (float("inf") if v > 0 else 0.0)))
+        self.last = {
+            "value_fast": v_fast, "value_slow": v_slow,
+            "burn_fast": burn(v_fast), "burn_slow": burn(v_slow),
+            "n_fast": len(fast), "n_slow": len(slow),
+        }
+        breach_fast = v_fast is not None and v_fast > self.bar
+        breach_slow = v_slow is not None and v_slow > self.bar
+        enough = (self.kind == "count"
+                  or len(fast) >= self.min_samples)
+        if not self.alerting and breach_fast and breach_slow and enough:
+            self.alerting = True
+            self.fired_ts = float(now)
+            self.alerts += 1
+            return "fired"
+        if self.alerting and not breach_fast:
+            self.alerting = False
+            duration = max(0.0, float(now) - (self.fired_ts or now))
+            self.total_alert_s += duration
+            self.resolved += 1
+            self.last["duration_s"] = duration
+            return "resolved"
+        return None
+
+    @property
+    def flaps(self):
+        """Re-fires after a resolve: fire/resolve/fire churn the gate
+        warns on when it grows past the baseline's."""
+        return max(0, self.alerts - 1)
+
+    def state(self):
+        return {
+            "objective": self.objective, "factor": self.factor,
+            "floor": self.floor, "bar": self.bar, "kind": self.kind,
+            "fast_window_s": self.fast_s, "slow_window_s": self.slow_s,
+            "min_samples": self.min_samples,
+            "alerting": self.alerting,
+            "active_since": self.fired_ts if self.alerting else None,
+            "alerts": self.alerts, "resolved": self.resolved,
+            "flaps": self.flaps,
+            "total_alert_s": round(self.total_alert_s, 6),
+            **self.last,
+        }
+
+
+class SLOMonitor:
+    """The live SLO burn-rate monitor (module docstring).
+
+    :arg legs: ``{name: spec}`` overriding/selecting legs. ``None``
+        enables every :data:`DEFAULT_LEGS` entry; passing a dict
+        enables ONLY the named legs, each spec merged over its default
+        (unknown names need a full spec). Per-leg keys: ``objective``,
+        ``factor``, ``floor``, ``kind``, ``fast_window_s``,
+        ``slow_window_s``, ``min_samples``, ``window_samples``.
+    :arg fast_window_s / slow_window_s / min_samples: window defaults
+        (fall back to the registered ``PYSTELLA_SLO_*`` knobs).
+    :arg label: tag carried on every alert event.
+    :arg emit: emit ``slo_alert``/``slo_resolved`` events on
+        transitions (default; ``False`` keeps the monitor silent for
+        embedding).
+    """
+
+    def __init__(self, legs=None, fast_window_s=None, slow_window_s=None,
+                 min_samples=None, label="slo", emit=True):
+        if fast_window_s is None:
+            fast_window_s = _config.get_float("PYSTELLA_SLO_FAST_WINDOW_S")
+        if slow_window_s is None:
+            slow_window_s = _config.get_float("PYSTELLA_SLO_SLOW_WINDOW_S")
+        if min_samples is None:
+            min_samples = _config.get_int("PYSTELLA_SLO_MIN_SAMPLES")
+        self.label = str(label)
+        self.emit_events = bool(emit)
+        chosen = (dict(DEFAULT_LEGS) if legs is None
+                  else {name: {**DEFAULT_LEGS.get(name, {}), **(spec or {})}
+                        for name, spec in legs.items()})
+        self._legs = {name: _LegState(name, spec, fast_window_s,
+                                      slow_window_s, min_samples)
+                      for name, spec in chosen.items()}
+        self._lock = threading.Lock()
+        self.ingested = 0
+        self.ingest_s = 0.0
+
+    # -- the EventLog subscriber --------------------------------------------
+
+    def handle(self, record):
+        """The :meth:`~pystella_tpu.obs.events.EventLog.subscribe`
+        callback: route one emitted record into its leg's window and
+        re-evaluate. Cheap by design (dict lookups + a deque append);
+        cumulative cost lands in ``ingest_s`` so the emit-path overhead
+        is auditable."""
+        t0 = time.perf_counter()
+        try:
+            self._ingest(record)
+        finally:
+            self.ingested += 1
+            self.ingest_s += time.perf_counter() - t0
+
+    def _ingest(self, record):
+        kind = record.get("kind")
+        data = record.get("data") or {}
+        ts = record.get("ts") or time.time()
+        hits = []
+        if kind == "service_dispatch":
+            q = data.get("queue_latency_s")
+            if isinstance(q, (int, float)):
+                hits.append(("queue_p95", float(q)))
+        elif kind == "service_lease":
+            t = data.get("ttfs_s")
+            if data.get("warm") and isinstance(t, (int, float)):
+                hits.append(("warm_ttfs", float(t)))
+        elif kind == "member_result":
+            if "deadline_missed" in data:
+                hits.append(("deadline_miss",
+                             1.0 if data["deadline_missed"] else 0.0))
+        elif kind == "fault_detected":
+            hits.append(("incident_rate", 1.0))
+        touched = False
+        for name, value in hits:
+            leg = self._legs.get(name)
+            if leg is not None:
+                with self._lock:
+                    leg.add(ts, value)
+                touched = True
+        if touched:
+            self.evaluate(now=ts)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, now=None):
+        """Re-evaluate every leg at ``now`` (default wall clock) and
+        emit any fire/resolve transitions; the ``/slo`` endpoint calls
+        this per scrape so aging-out resolution does not wait for the
+        next ingested event. Returns the transitions as
+        ``[(leg, "fired"|"resolved"), ...]``."""
+        now = time.time() if now is None else float(now)
+        transitions = []
+        with self._lock:
+            for name, leg in self._legs.items():
+                change = leg.evaluate(now)
+                if change:
+                    transitions.append((name, change, dict(leg.last),
+                                        leg))
+        for name, change, last, leg in transitions:
+            if not self.emit_events:
+                continue
+            if change == "fired":
+                _events.emit("slo_alert", leg=name,
+                             value=last.get("value_fast"),
+                             bar=leg.bar,
+                             burn_fast=last.get("burn_fast"),
+                             burn_slow=last.get("burn_slow"),
+                             n_fast=last.get("n_fast"),
+                             n_slow=last.get("n_slow"),
+                             objective=leg.objective,
+                             factor=leg.factor, floor=leg.floor,
+                             label=self.label)
+            else:
+                _events.emit("slo_resolved", leg=name,
+                             value=last.get("value_fast"),
+                             bar=leg.bar,
+                             duration_s=round(
+                                 last.get("duration_s") or 0.0, 6),
+                             label=self.label)
+        return [(name, change) for name, change, _, _ in transitions]
+
+    # -- introspection -------------------------------------------------------
+
+    def state(self):
+        """The JSON-safe burn-rate state (the ``/slo`` payload): every
+        leg's windowed values, burn rates, bar, and alert bookkeeping,
+        plus monitor totals."""
+        with self._lock:
+            legs = {name: leg.state()
+                    for name, leg in self._legs.items()}
+        unresolved = sorted(n for n, s in legs.items() if s["alerting"])
+        return {
+            "label": self.label,
+            "legs": legs,
+            "alerting": unresolved,
+            "alerts_total": sum(s["alerts"] for s in legs.values()),
+            "resolved_total": sum(s["resolved"] for s in legs.values()),
+            "flaps_total": sum(s["flaps"] for s in legs.values()),
+            "ingested": self.ingested,
+            "ingest_s": round(self.ingest_s, 6),
+        }

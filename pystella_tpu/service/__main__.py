@@ -20,6 +20,14 @@ loop's ``service_done`` — so a reused log reports the current loop
 Retired rows carry each request's trace id (obs schema v2), so the
 next hop from "request 7 was slow" is
 ``python -m pystella_tpu.obs.spans --events <log> --trace <id>``.
+
+``status --follow`` is the live tail: when the registered
+``PYSTELLA_LIVE_PORT`` (or ``--url``) names a live telemetry endpoint
+(:mod:`pystella_tpu.obs.live`), each tick polls ``/healthz`` + ``/slo``
+and prints one line of serve-loop state and SLO burn; when no endpoint
+is reachable it falls back to re-reading the rotated event-log family
+per tick — the offline reconstruction, repeated — so the same command
+tails a live server, a server without the live plane, and a dead one.
 """
 
 from __future__ import annotations
@@ -27,11 +35,12 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from pystella_tpu import config as _config
 from pystella_tpu.obs import events as _events
 
-__all__ = ["reconstruct", "main"]
+__all__ = ["follow_line", "reconstruct", "main"]
 
 
 def reconstruct(events_path):
@@ -203,11 +212,79 @@ def _render(state, last):
     return "\n".join(lines)
 
 
+def _live_poll(base_url, timeout=2.0):
+    """One poll of a live telemetry endpoint: ``(healthz, slo)`` dicts,
+    or ``None`` when it is unreachable (the caller falls back to the
+    offline reconstruction)."""
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(base_url + "/healthz",
+                                    timeout=timeout) as r:
+            healthz = json.loads(r.read().decode())
+        with urllib.request.urlopen(base_url + "/slo",
+                                    timeout=timeout) as r:
+            slo = json.loads(r.read().decode())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+    return healthz, slo
+
+
+def follow_line(healthz, slo):
+    """One ``--follow`` tick rendered from a live poll."""
+    burning = slo.get("alerting") or []
+    return (
+        f"live: {'SERVING' if healthz.get('serving') else 'idle'} · "
+        f"queue {healthz.get('queue_depth')} · lease "
+        f"{healthz.get('active_lease') if healthz.get('active_lease') is not None else '—'}"
+        f" · {healthz.get('leases_completed')} lease(s) done · slo "
+        + (f"BURNING [{', '.join(burning)}]" if burning
+           else ("ok" if slo.get("enabled") else "off")))
+
+
+def _offline_line(events_path):
+    state = reconstruct(events_path)
+    leases = state["leases"]
+    return (f"offline: queue {state['queue_depth']} · "
+            f"{len(leases['active'])} active lease(s) · "
+            f"{leases['completed']} completed, {leases['failed']} "
+            f"failed · {len(state['retired'])} retired"
+            + (" · serve loop FINISHED" if state["done"] else ""))
+
+
+def _follow(events_path, url, interval, count):
+    """The live-tail loop: poll the endpoint when one is configured
+    (falling back per tick when it is unreachable — the server may not
+    be up yet, or just went down), else re-read the event-log family.
+    ``count`` bounds the ticks (0 = forever)."""
+    ticks = 0
+    while True:
+        line = None
+        if url:
+            polled = _live_poll(url)
+            if polled is not None:
+                line = follow_line(*polled)
+        if line is None:
+            if not events_path:
+                print("service status --follow: live endpoint "
+                      "unreachable and no --events/PYSTELLA_EVENT_LOG "
+                      "to fall back to", file=sys.stderr)
+                return 2
+            line = _offline_line(events_path)
+        print(time.strftime("%H:%M:%S") + " " + line, flush=True)
+        ticks += 1
+        if count and ticks >= count:
+            return 0
+        time.sleep(max(0.0, interval))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m pystella_tpu.service",
-        description="scenario-service ops tools (offline: everything "
-                    "reconstructs from the event-log family)")
+        description="scenario-service ops tools (offline "
+                    "reconstruction from the event-log family, or a "
+                    "live tail against the PYSTELLA_LIVE_PORT "
+                    "endpoint)")
     sub = p.add_subparsers(dest="cmd", required=True)
     ps = sub.add_parser(
         "status", help="queue depth, tenant occupancy, active leases, "
@@ -220,9 +297,29 @@ def main(argv=None):
     ps.add_argument("--json", action="store_true",
                     help="print the raw reconstruction instead of the "
                          "rendered view")
+    ps.add_argument("--follow", action="store_true",
+                    help="live tail: poll the PYSTELLA_LIVE_PORT "
+                         "endpoint (/healthz + /slo) each tick, "
+                         "falling back to re-reading the event-log "
+                         "family when no endpoint answers")
+    ps.add_argument("--url", default=None,
+                    help="live endpoint base URL override (default "
+                         "http://127.0.0.1:$PYSTELLA_LIVE_PORT when "
+                         "the port is set)")
+    ps.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll interval in seconds (default 2)")
+    ps.add_argument("--count", type=int, default=0,
+                    help="--follow tick budget, 0 = follow forever "
+                         "(default)")
     args = p.parse_args(argv)
 
     events_path = args.events or _config.getenv("PYSTELLA_EVENT_LOG")
+    if args.follow:
+        url = args.url
+        if url is None:
+            port = _config.get_int("PYSTELLA_LIVE_PORT") or 0
+            url = f"http://127.0.0.1:{port}" if port > 0 else None
+        return _follow(events_path, url, args.interval, args.count)
     if not events_path:
         print("service status: no --events and no PYSTELLA_EVENT_LOG "
               "set", file=sys.stderr)

@@ -20,24 +20,56 @@ TPU-window ``service`` leg scales it up):
   ``run()`` re-verifies that against an uninterrupted replay through
   the same warm program and reports ``preempt_bitexact``;
 - **one quota rejection**: the heaviest tenant submits one request past
-  its admission quota.
+  its admission quota;
+- **one certain SLO burn alert**: a seeded
+  :class:`~pystella_tpu.obs.slo.SLOMonitor` rides the run
+  (:func:`seeded_slo_monitor`) with its ``deadline_miss`` leg windowed
+  to the last sample — bravo's impossible 20 ms deadline fires
+  ``slo_alert`` at its guaranteed miss, charlie's unmissable 60 s
+  deadline resolves it at the next retire, so BOTH live-alert
+  transitions land in every smoke record deterministically (the
+  queue/TTFS legs run with deliberately generous objectives so only
+  the seeded leg can fire). The monitor's ingest cost is measured and
+  reported (``slo.ingest_s``) — the emit-path overhead pin.
 
 Everything lands in the configured event log; the perf ledger's
-``service`` section and the gate's SLO verdicts consume it from there.
+``service``/``latency``/``alerts`` sections and the gate's SLO + alert
+verdicts consume it from there.
 """
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import slo as _slo
 from pystella_tpu.service.admission import request_signature
 from pystella_tpu.service.queue import (
     FairShareScheduler, ScenarioRequest)
 from pystella_tpu.service.results import ResultEmitter
 from pystella_tpu.service.server import ScenarioService
 
-__all__ = ["run", "build_preheat_model"]
+__all__ = ["run", "build_preheat_model", "seeded_slo_monitor"]
+
+
+def seeded_slo_monitor(label="loadgen"):
+    """The loadgen's deterministic SLO-monitor configuration: the
+    ``deadline_miss`` leg is capped at the LAST deadline verdict
+    (``window_samples=1``), so the mix's one guaranteed miss fires the
+    alert and the next guaranteed hit resolves it — one certain
+    fire+resolve pair per run, independent of wall-clock windows. The
+    queue/TTFS legs keep running with objectives far above anything a
+    smoke mix produces (they exist so the ingest path is exercised, not
+    to fire), and the incident leg keeps its default (it fires only
+    when a drill injects faults)."""
+    return _slo.SLOMonitor(legs={
+        "queue_p95": {"objective": 120.0},
+        "warm_ttfs": {"objective": 120.0},
+        "deadline_miss": {"window_samples": 1, "min_samples": 1},
+        "incident_rate": {},
+    }, label=label)
 
 
 def build_preheat_model(dtype=np.float32):
@@ -134,26 +166,34 @@ def _uninterrupted_reference(entry, request, slots, chunk):
 
 def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
         cold_grid=12, nsteps=8, quota=3, label="loadgen",
-        spectra=True, faults=None, store=None):
+        spectra=True, faults=None, store=None, slo=None):
     """Drive one full synthetic service run (module docstring).
     Returns the stats dict (also emitted as a ``service_loadgen``
     event). ``grid``/``cold_grid`` are the warm/cold lattice edges;
     ``nsteps`` the per-request step budget (a multiple of the chunk
     keeps retire boundaries aligned); ``faults`` threads a
-    FaultInjector into every lease's supervisor (drills)."""
+    FaultInjector into every lease's supervisor (drills); ``slo`` an
+    :class:`~pystella_tpu.obs.slo.SLOMonitor` override (default: the
+    :func:`seeded_slo_monitor`; ``False`` disables the live monitor
+    entirely, restoring the pre-live event record byte for byte)."""
     import pystella_tpu as ps
 
     rng = np.random.default_rng(seed)
     warm_sig = request_signature("preheat", (grid,) * 3)
     cold_sig = request_signature("preheat", (cold_grid,) * 3)
 
+    if slo is None:
+        slo = seeded_slo_monitor(label=label)
+    elif slo is False:
+        slo = None
     scheduler = FairShareScheduler(
         quota=quota, weights={"alpha": 2.0, "bravo": 1.0,
                               "charlie": 1.0})
     results = _CapturingEmitter(label=label)
     service = ScenarioService(checkpoint_dir, slots=slots, chunk=chunk,
                               scheduler=scheduler, results=results,
-                              store=store, faults=faults, label=label)
+                              store=store, faults=faults, slo=slo,
+                              label=label)
     service.register_model("preheat", build_preheat_model())
 
     # deploy-time arming: the warm signature's program is traced,
@@ -201,7 +241,9 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
                            seed=8, priority=3)
     service.schedule_arrival(1, high)
 
+    t_serve0 = time.perf_counter()
     summary = service.serve()
+    serve_wall_s = time.perf_counter() - t_serve0
 
     # bit-consistency re-verification: every preempted-and-resumed
     # request's final state must equal its uninterrupted replay
@@ -241,7 +283,22 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
         # service_dispatch events share the id)
         "traces": sorted(r.trace_id for r in mix + [high]
                          if r.trace_id is not None),
+        "serve_wall_s": round(serve_wall_s, 4),
     }
+    if slo is not None:
+        state = slo.state()
+        stats["slo"] = {
+            "alerts": state["alerts_total"],
+            "resolved": state["resolved_total"],
+            "flaps": state["flaps_total"],
+            "alerting": state["alerting"],
+            "ingested": state["ingested"],
+            "ingest_s": state["ingest_s"],
+            # the emit-path overhead pin: the monitor's whole ingest
+            # cost as a share of the serve wall (< 2% by contract)
+            "overhead_pct": round(100.0 * state["ingest_s"]
+                                  / max(serve_wall_s, 1e-9), 4),
+        }
     _events.emit("service_loadgen", seed=seed, **stats)
     return stats
 

@@ -62,6 +62,7 @@ import time
 from pystella_tpu import config as _config
 from pystella_tpu.obs import events as _events
 from pystella_tpu.obs import memory as _memory
+from pystella_tpu.obs import metrics as _metrics
 from pystella_tpu.service.admission import (
     AdmissionController, WarmPool, parse_signature)
 from pystella_tpu.service.queue import FairShareScheduler, QuotaExceeded
@@ -242,6 +243,12 @@ class ScenarioService:
         for leases holding a real mesh slice.
     :arg cold_policy: admission cold policy override
         (``PYSTELLA_SERVICE_COLD_POLICY``).
+    :arg slo: optional :class:`~pystella_tpu.obs.slo.SLOMonitor`
+        subscribed to the process event log for the duration of
+        :meth:`serve` (live burn-rate alerts; the registered
+        ``PYSTELLA_LIVE_PORT`` endpoint serves its state at ``/slo``).
+        When the live endpoint is on and no monitor was given, a
+        default one is built.
     :arg label: tag carried on every event.
     """
 
@@ -249,7 +256,7 @@ class ScenarioService:
                  scheduler=None, pool=None, admission=None, store=None,
                  results=None, preempt=None, checkpoint_chunks=2,
                  faults=None, retry=None, planner_factory=None,
-                 cold_policy=None, label="service"):
+                 cold_policy=None, slo=None, label="service"):
         self.checkpoint_dir = os.path.abspath(str(checkpoint_dir))
         self.slots = int(slots if slots is not None
                          else _config.get_int("PYSTELLA_SERVICE_SLOTS"))
@@ -270,11 +277,17 @@ class ScenarioService:
         self.faults = faults
         self.retry = retry
         self.planner_factory = planner_factory
+        self.slo = slo
+        self.live_server = None
         self.label = str(label)
         self._models = {}
         self._arrivals = []          # (due_total_chunks, request)
         self._total_chunks = 0
         self._lease_seq = 0
+        self._serving = False
+        self._active_lease = None
+        self._last_chunk_ts = None
+        self.last_chunk_member_steps_per_s = None
         self.totals = {
             "submitted": 0, "admitted": 0, "rejected": {},
             "completed": 0, "diverged": 0, "preemptions": 0,
@@ -313,6 +326,7 @@ class ScenarioService:
         :class:`~pystella_tpu.service.admission.AdmissionVerdict`
         (falsy == rejected, with the typed reason)."""
         self.totals["submitted"] += 1
+        _metrics.counter("service.submitted").inc()
         verdict = self.admission.admit(request)
         if not verdict.admitted:
             return self._reject(request, verdict,
@@ -383,19 +397,128 @@ class ScenarioService:
     def _on_chunk(self, lease):
         """Called by the lease at every chunk boundary: count it, admit
         any due arrivals, and trigger the preemption drain when a
-        strictly higher priority class is now waiting."""
+        strictly higher priority class is now waiting. Also the live
+        throughput gauge's heartbeat: the wall time between two chunk
+        boundaries over the batch's member-steps is the
+        last-chunk member-steps/s the ``/metrics`` endpoint exposes."""
+        now = time.perf_counter()
+        if self._last_chunk_ts is not None and now > self._last_chunk_ts:
+            steps = lease.chunk * lease.entry.ens.size
+            self.last_chunk_member_steps_per_s = \
+                steps / (now - self._last_chunk_ts)
+            _metrics.gauge("service.member_steps_per_s").set(
+                self.last_chunk_member_steps_per_s)
+        self._last_chunk_ts = now
+        _metrics.counter("service.chunks").inc()
         self._total_chunks += 1
         self._poll_arrivals()
         if (self.preempt_enabled and lease.supervisor is not None
                 and self.scheduler.has_priority_above(lease.priority)):
             lease.supervisor.request_preemption()
 
+    # -- the live operations plane -------------------------------------------
+
+    def live_status(self):
+        """A consistent-enough point-in-time view for the live
+        telemetry endpoint (:mod:`pystella_tpu.obs.live`), safe to call
+        from the scrape thread while the serve loop runs: queue depth
+        overall / per priority class / per tenant, the active lease and
+        its supervisor's drain state, warm-pool entries split by live
+        fingerprint match, and the last chunk's member-steps/s. Reads
+        are snapshot-copied list/dict walks — no locks are taken, so a
+        scrape can never stall a dispatch."""
+        queue = list(getattr(self.scheduler, "_queue", []))
+        by_class, by_tenant = {}, {}
+        for r in queue:
+            cls = str(r.priority)
+            by_class[cls] = by_class.get(cls, 0) + 1
+            by_tenant[r.tenant] = by_tenant.get(r.tenant, 0) + 1
+        pool_ok = pool_stale = 0
+        for sig in self.pool.signatures():
+            entry = self.pool.get(sig)
+            try:
+                ok = bool(entry is not None and entry.fingerprint_ok())
+            except Exception:  # noqa: BLE001 — a scrape never raises
+                ok = False
+            pool_ok, pool_stale = (pool_ok + ok, pool_stale + (not ok))
+        lease = self._active_lease
+        supervisor = None
+        if lease is not None and lease.supervisor is not None:
+            supervisor = {
+                "lease": lease.id,
+                "draining": getattr(lease.supervisor,
+                                    "_preempt_signum", None) is not None,
+                "members": len(lease.requests),
+                "finished": len(lease.finished),
+                "diverged": len(lease.diverged),
+            }
+        return {
+            "serving": self._serving,
+            "queue_depth": len(queue),
+            "queue_by_priority": by_class,
+            "queue_by_tenant": by_tenant,
+            "active_lease": None if lease is None else lease.id,
+            "active_leases": 0 if lease is None else 1,
+            "supervisor": supervisor,
+            "leases_completed": self.totals["leases"],
+            "lease_failures": self.totals["lease_failures"],
+            "completed": self.totals["completed"],
+            "preemptions": self.totals["preemptions"],
+            "warm_pool": {"ok": pool_ok, "stale": pool_stale},
+            "last_chunk_member_steps_per_s":
+                self.last_chunk_member_steps_per_s,
+        }
+
+    def _live_begin(self):
+        """Bring the opt-in live plane up around one serve loop: build
+        a default SLO monitor when the endpoint is on and none was
+        given, subscribe the monitor to the process event log (the
+        in-process push channel), and start the ``PYSTELLA_LIVE_PORT``
+        endpoint. Returns the subscribed-monitor flag for
+        :meth:`_live_end`."""
+        port = _config.get_int("PYSTELLA_LIVE_PORT") or 0
+        if port > 0 and self.slo is None:
+            from pystella_tpu.obs import slo as _slo
+            self.slo = _slo.SLOMonitor(label=self.label)
+        attached = False
+        if self.slo is not None:
+            _events.get_log().subscribe(self.slo.handle)
+            attached = True
+        if port > 0:
+            from pystella_tpu.obs import live as _live
+            self.live_server = _live.start_from_env(
+                service=self, slo=self.slo, label=self.label)
+        return attached
+
+    def _live_end(self, attached):
+        """Tear the live plane down (final monitor evaluation first, so
+        an alert that should resolve by aging does before the record
+        closes)."""
+        if self.slo is not None:
+            self.slo.evaluate()
+        if attached:
+            _events.get_log().unsubscribe(self.slo.handle)
+        if self.live_server is not None:
+            self.live_server.close()
+            self.live_server = None
+
     # -- serving -------------------------------------------------------------
 
     def serve(self, max_leases=None):
         """Drain the queue (and any scheduled arrivals): dispatch
         leases until idle. Returns the service summary dict (also
-        emitted as ``service_done``)."""
+        emitted as ``service_done``). While the loop runs, the opt-in
+        live plane (``PYSTELLA_LIVE_PORT`` endpoint + SLO burn-rate
+        monitor) is up; both come down with the loop."""
+        attached = self._live_begin()
+        self._serving = True
+        try:
+            return self._serve_loop(max_leases)
+        finally:
+            self._serving = False
+            self._live_end(attached)
+
+    def _serve_loop(self, max_leases):
         _events.emit("service_start", label=self.label,
                      slots=self.slots, chunk=self.chunk,
                      preempt=self.preempt_enabled,
@@ -474,6 +597,7 @@ class ScenarioService:
             # the pre-preemption wait)
             r.queue_latency_s = max(0.0, now - (r.submit_ts or now))
             r.status = "running"
+            _metrics.counter("service.dispatches").inc()
             with _events.tracing(trace=r.trace_id, parent=r.span_id):
                 _events.emit("service_dispatch", id=r.id,
                              tenant=r.tenant,
@@ -484,12 +608,22 @@ class ScenarioService:
         lease = _Lease(self, entry, requests, lease_id, t_origin,
                        cold_build_s=cold_build_s)
         self.totals["leases"] += 1
-        with _memory.compile_watch(f"service.lease{lease_id}") as w:
-            try:
-                rep = self._supervised_run(lease)
-            except Exception as e:  # noqa: BLE001 — the service survives
-                self._lease_failed(lease, e)
-                return None
+        _metrics.counter("service.leases").inc()
+        self._active_lease = lease
+        # the chunk-rate gauge measures within-lease cadence only: the
+        # inter-lease gap (retire, checkpointing, a cold build) is not
+        # compute, so the first chunk of a new lease must not divide
+        # by it
+        self._last_chunk_ts = None
+        try:
+            with _memory.compile_watch(f"service.lease{lease_id}") as w:
+                try:
+                    rep = self._supervised_run(lease)
+                except Exception as e:  # noqa: BLE001 — service survives
+                    self._lease_failed(lease, e)
+                    return None
+        finally:
+            self._active_lease = None
         backend_compiles = int(w.cache_misses) if (
             w.cache_hits or w.cache_misses) else (
             1 if w.compile_seconds > 0 else 0)
@@ -580,6 +714,7 @@ class ScenarioService:
         unfinished member's restored state re-enters the queue and its
         next lease resumes the same trajectory."""
         self.totals["preemptions"] += 1
+        _metrics.counter("service.preemptions").inc()
         requeued = []
         for m in lease.active_members():
             req = lease.requests[m]
@@ -608,6 +743,7 @@ class ScenarioService:
             req = lease.requests[m]
             req.status = "completed"
             self.totals["completed"] += 1
+            _metrics.counter("service.completed").inc()
             self.results.emit(req, state, status="completed",
                               lease=lease.id)
         for m, ev in sorted(lease.diverged.items()):

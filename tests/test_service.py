@@ -517,6 +517,21 @@ def test_loadgen_mix_and_service_section(loadgen_report):
                if r["margin_s"] is not None]
     assert any(m < 0 for m in margins) and any(m > 0 for m in margins)
 
+    # the seeded live burn alert (obs.slo): the guaranteed deadline
+    # miss FIRES it, the next guaranteed hit RESOLVES it — both
+    # transitions in the event record, the ledger's alerts section
+    # populated, nothing left burning at exit
+    assert stats["slo"]["alerts"] >= 1
+    assert stats["slo"]["resolved"] == stats["slo"]["alerts"]
+    assert stats["slo"]["alerting"] == []
+    al = rep["alerts"]
+    assert al["by_leg"]["deadline_miss"]["alerts"] >= 1
+    assert al["by_leg"]["deadline_miss"]["resolved"] >= 1
+    assert al["unresolved"] == []
+    # the emit-path subscriber overhead pin: the monitor's whole
+    # ingest cost stays under 2% of the serve wall
+    assert stats["slo"]["overhead_pct"] < 2.0, stats["slo"]
+
 
 def test_loadgen_gate_slo_legs(loadgen_report):
     _stats, rep = loadgen_report
@@ -581,6 +596,22 @@ def test_loadgen_gate_slo_legs(loadgen_report):
     v = gate.compare_reports(rep, clean)
     assert v["exit_code"] == 0
     assert any("deadline-miss improvement" in w_ for w_ in v["warnings"])
+
+    # an unresolved live burn alert beside a GREEN post-hoc SLO section
+    # is a live/post-hoc contradiction -> refusal (exit 2); --no-alerts
+    # opts out. The loadgen's own record passes (its seeded alert
+    # resolved — asserted above), so the self-comparison staying exit 0
+    # doubles as the resolved-alert acceptance leg.
+    stuck = copy.deepcopy(rep)
+    stuck["alerts"]["unresolved"] = [
+        {"leg": "deadline_miss", "since_ts": 1.0, "value": 1.0,
+         "bar": 0.1}]
+    v = gate.compare_reports(rep, stuck)
+    assert v["exit_code"] == 2
+    assert any("live burn alert" in r and "claims green" in r
+               for r in v["reasons"])
+    assert gate.compare_reports(rep, stuck,
+                                check_alerts=False)["exit_code"] == 0
 
     # an unassembled span tree is a coverage-loss warning, never a
     # refusal (the request may legitimately still be in flight)
