@@ -711,9 +711,10 @@ def run_resident_parity(n=64, dtype=np.float32):
 def run_block_sweep(n=128, nsteps=5, dtype=np.float32):
     """Mini (bx, by) block-size sweep of the fused stage on the held
     device; returns ``(best_bx, best_by, best_ms)`` (VERDICT round 2,
-    next-round #2: record the sweep in-repo). ``bench_tune.py`` does the
-    full sweep; this captures a coarse table whenever ANY bench reaches
-    real hardware."""
+    next-round #2: record the sweep in-repo). The persistent autotuner
+    (``python -m pystella_tpu.ops.autotune sweep``) does the full
+    sweep and RECORDS winners per device kind; this captures a coarse
+    table whenever ANY bench reaches real hardware."""
     import jax
     import pystella_tpu as ps
 
@@ -947,6 +948,16 @@ def run_smoke(argv=None):
                         "bit-consistent resume; the report's `service` "
                         "section and the gate's SLO verdicts derive "
                         "from it")
+    p.add_argument("--no-autotune", action="store_true",
+                   help="skip the fused-tier + autotune payload: a "
+                        "tiny (bx, by, chunk-depth) sweep persisting "
+                        "its winner to <out>/autotune_<device>.json, "
+                        "the pair-vs-whole-RK-chunk steppers dispatched "
+                        "back to back (bit-exact pin + the roofline's "
+                        "kernel-tier traffic-reduction record), and a "
+                        "table-hit rebuild dispatched against the warm "
+                        "compilation cache with ZERO extra backend "
+                        "compiles (compile-watch proof)")
     p.add_argument("--no-spectra", action="store_true",
                    help="skip the sharded-spectra payload: a 16^3 "
                         "2-field power spectrum on the 8-device "
@@ -1167,6 +1178,107 @@ def run_smoke(argv=None):
                  complex_itemsize=8, label="smoke-spectra")
         hb(f"smoke: sharded spectra ({sfft.scheme}) p50 "
            f"{ms_p50:.2f} ms/call over {len(spectra_times)} call(s)")
+
+    # fused-tier + autotune payload: the temporal-blocking rung of the
+    # kernel ladder, end to end on the smoke budget. (a) A tiny
+    # (bx, by, chunk-depth) sweep through ops.autotune persists its
+    # winner to <out>/autotune_<device-kind>.json — the same candidate
+    # model (choose_blocks' VMEM feasibility) and min-over-rounds
+    # paired estimator a hardware window uses. (b) The pair-tier and
+    # whole-RK-chunk steppers advance the same trajectory back to
+    # back: the chunked path is pinned bit-exact against the pair
+    # sequence it replaces, and both emit kernel_tier dispatch
+    # records, so the report's roofline section carries the measured
+    # per-step HBM-traffic reduction. (c) A fresh stepper built over
+    # the table (chunk_stages=None -> consult) picks the recorded
+    # winner (block_choice source="autotune") and a SECOND table-hit
+    # build dispatches against the now-warm compilation cache with
+    # ZERO extra backend compiles — the compile-watch proof that a
+    # tuned kernel is warm-servable (the scenario service's
+    # dispatch-never-compile contract extends to tuned programs).
+    if not args.no_autotune:
+        try:
+            from pystella_tpu.ops import autotune as ps_autotune
+            at_store = ps_autotune.AutotuneStore(root=args.out)
+            at_grid = (16, 16, 16)
+            # max_blocks=1: one pair + one chunk candidate — the table
+            # round trip and winner record are what smoke proves; the
+            # breadth of the sweep grid is the hardware window's job
+            ps_autotune.sweep(at_grid, store=at_store, nsteps=2,
+                              rounds=2, max_blocks=1,
+                              chunk_depths=(0, 4), log=lambda m: None)
+            hb(f"smoke: autotune sweep ({at_store.device_kind}) -> "
+               f"{at_store.path}")
+
+            at_dt = np.float32(0.1 * 5.0 / at_grid[0])
+            at_args = {"a": np.float32(1.0), "hubble": np.float32(0.5)}
+            at_t = np.float32(0.0)
+            pair_st, at_state = ps_autotune._build_sweep_stepper(
+                at_grid, {"chunk": 0, "bx": 4, "by": 8})
+            chunk_st, _ = ps_autotune._build_sweep_stepper(
+                at_grid, {"chunk": 4, "bx": 4, "by": 8})
+            at_host = {k: np.asarray(v) for k, v in at_state.items()}
+
+            def at_fresh():
+                return {k: jax.device_put(v) for k, v in at_host.items()}
+
+            at_ref = pair_st.multi_step(at_fresh(), 4, at_t, at_dt,
+                                        at_args)
+            at_got = chunk_st.multi_step(at_fresh(), 4, at_t, at_dt,
+                                         at_args)
+            sync(at_ref)
+            sync(at_got)
+            at_bitexact = all(
+                np.array_equal(np.asarray(at_got[k]),
+                               np.asarray(at_ref[k])) for k in at_ref)
+            tier_pair = pair_st.kernel_tier_report()
+            tier_chunk = chunk_st.kernel_tier_report()
+            at_red = 1.0 - (tier_chunk["bytes_per_step"]
+                            / tier_pair["bytes_per_step"])
+            hb(f"smoke: fused tiers {tier_chunk['tier']} "
+               f"{tier_chunk['bytes_per_step']:,} B/step vs pair "
+               f"{tier_pair['bytes_per_step']:,} B/step "
+               f"({at_red:.0%} less lattice traffic), "
+               f"bit-exact={at_bitexact}")
+            if not (at_bitexact and chunk_st._chunk_call is not None):
+                obs.emit("smoke_autotune_failed", bitexact=at_bitexact,
+                         chunk_built=chunk_st._chunk_call is not None)
+
+            # table-hit rebuild: consult -> winner blocks -> dispatch.
+            # The first tuned build's step program lands in the
+            # persistent cache; the second build's dispatch must then
+            # be compile-free (the undonated step program is
+            # cache-eligible on every backend).
+            tuned1, _ = ps_autotune._build_sweep_stepper(
+                at_grid, {}, autotune=at_store)
+            at_hit = tuned1._autotune_entry is not None
+            sync(tuned1.step(at_fresh(), at_t, at_dt, at_args))
+            tuned2, _ = ps_autotune._build_sweep_stepper(
+                at_grid, {}, autotune=at_store)
+            with obs.compile_watch("autotune_warm_build") as at_w:
+                sync(tuned2.step(at_fresh(), at_t, at_dt, at_args))
+            at_compiles = at_w.backend_compiles
+            if cache_dir:
+                obs.emit("autotune_warm_build",
+                         table_hit=at_hit and
+                         tuned2._autotune_entry is not None,
+                         backend_compiles=at_compiles,
+                         cache_hits=at_w.cache_hits,
+                         cache_misses=at_w.cache_misses,
+                         trace_s=round(at_w.trace_seconds, 4),
+                         compile_s=round(at_w.compile_seconds, 4),
+                         table=at_store.path)
+                hb(f"smoke: autotune table-hit rebuild "
+                   f"(hit={at_hit}) dispatched with "
+                   f"{at_compiles} backend compile(s) "
+                   f"({at_w.cache_hits} cache hit(s))")
+            else:
+                hb("smoke: compilation cache disabled — skipping the "
+                   "zero-compile table-hit proof")
+        except Exception as e:  # noqa: BLE001 — record, never kill smoke
+            hb(f"smoke: fused-tier/autotune payload failed: "
+               f"{type(e).__name__}: {e}")
+            traceback.print_exc()
 
     # ensemble payload: a batched scenario population (8 members x 16^3
     # packed along the ensemble mesh axis) through the EnsembleDriver

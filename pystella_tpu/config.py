@@ -284,6 +284,34 @@ register("PYSTELLA_TRACE_EXPORT", default=None, kind="path",
               "explicit --perfetto is given, and bench.py --smoke "
               "mirrors its service_trace.json export to it; unset "
               "skips the extra copy")
+register("PYSTELLA_AUTOTUNE", default="1", kind="bool",
+         help="persistent-autotuner consult policy for fused Pallas "
+              "kernel builds (ops.autotune): 1 (default) consults "
+              "bench_results/autotune_<device-kind>.json before the "
+              "choose_blocks heuristic (stale entries are refused with "
+              "an autotune_mismatch event, exactly like stale AOT "
+              "warm-start artifacts); 0 skips the table entirely — the "
+              "tier-1 suite pins 0 so ambient builds stay hermetic")
+register("PYSTELLA_AUTOTUNE_DIR", default="bench_results", kind="path",
+         help="directory of the persistent autotune winner tables "
+              "(autotune_<device-kind>.json, one per device kind); "
+              "relative paths anchor at the repository root; the sweep "
+              "CLI (python -m pystella_tpu.ops.autotune) writes there "
+              "and kernel builds read back through the same store")
+register("PYSTELLA_CHUNK_STAGES", default="0", kind="int",
+         help="default temporal-blocking chunk depth for the fused "
+              "steppers when no chunk_stages= argument and no autotune "
+              "table entry decides it: an even number >= 4 of RK "
+              "stages advanced per resident whole-RK-chunk kernel "
+              "(VMEM-window halo widens by h per stage pair; "
+              "infeasible shapes degrade to pair kernels with a "
+              "kernel_fallback event); 0 (default) keeps the "
+              "pair-stage tier")
+register("PYSTELLA_FORCE_BLOCKS", default=None,
+         help="'bx,by' override for the fused steppers' streaming-"
+              "kernel blocking — beats both the autotune table and the "
+              "choose_blocks heuristic (sweep harness escape hatch; "
+              "the block_choice event records source='override')")
 register("PYSTELLA_FFT_SCHEME", default="auto",
          help="distributed-FFT scheme the planner (fourier.plan."
               "make_dft) and the spectra/projector/Poisson consumers "

@@ -158,6 +158,42 @@ def build_fused_multi_step():
     return fn, args, kwargs, state
 
 
+def build_chunk_multi_step():
+    """``FusedScalarStepper.multi_step`` with the whole-RK-chunk
+    (temporal blocking) kernel dispatched — the depth-4 resident-chunk
+    program the roofline's tier record names, audited for donation /
+    dtype / collectives exactly like the pair-tier chunk program."""
+    import jax.numpy as jnp
+    import pystella_tpu as ps
+    decomp = _mesh_decomp(want_sharded=False)
+    lattice = ps.Lattice(GRID, (5.0, 5.0, 5.0), dtype=np.float32)
+
+    def potential(f):
+        return 0.5 * 1.2e-2 * f[0] ** 2 + 0.125 * f[0] ** 2 * f[1] ** 2
+
+    sector = ps.ScalarSector(2, potential=potential)
+    stepper = ps.FusedScalarStepper(
+        sector, decomp, GRID, lattice.dx, 2, dtype=jnp.float32,
+        chunk_stages=4, chunk_bx=4, chunk_by=8, autotune=False)
+    if stepper._chunk_call is None:
+        raise RuntimeError("chunk kernel failed to build at the audit "
+                           "shape — the fallback warning says why")
+    rng = np.random.default_rng(11)
+    state = {
+        "f": decomp.shard(
+            1e-3 * rng.standard_normal((2,) + GRID).astype(np.float32)),
+        "dfdt": decomp.shard(
+            1e-4 * rng.standard_normal((2,) + GRID).astype(np.float32)),
+    }
+    fn = stepper._multi_jit(2)
+    args = (state,)
+    kwargs = {"t": np.float32(0.0), "dt": np.float32(0.01),
+              "rhs_args": {"a": np.float32(1.0),
+                           "hubble": np.float32(0.5)},
+              "rhs_seq": {}}
+    return fn, args, kwargs, state
+
+
 def build_coupled_multi_step():
     """``FusedScalarStepper.coupled_multi_step`` (on-device Friedmann
     background) — the expanding-universe chunk program."""
@@ -318,6 +354,13 @@ def default_targets():
             dtype_policy=POLICY_F32,
             collectives=dict(REDUCTION_COLLECTIVES),
             fused_scopes=("fused_rk_stage", "sentinel"),
+        ),
+        GraphTarget(
+            name="chunk_multi_step",
+            build=build_chunk_multi_step,
+            dtype_policy=POLICY_F32,
+            collectives={},
+            fused_scopes=("chunk_stage",),
         ),
         GraphTarget(
             name="coupled_multi_step",

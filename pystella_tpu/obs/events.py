@@ -191,6 +191,26 @@ for _name, _help in (
     ("mg_cycle", "one multigrid cycle (depth, smooths, errors)"),
     ("assemble_fallback", "explicit assemble='update' fell back to the "
                           "resident kernel tier"),
+    # -- fused kernel tiers + the persistent autotuner (ops.autotune) -------
+    ("block_choice", "a fused kernel build chose its blocking "
+                     "(bx/by/win_halo + source: autotune table hit, "
+                     "choose_blocks heuristic, env override, explicit)"),
+    ("kernel_fallback", "a fused kernel tier degraded down the ladder "
+                        "(chunk -> pair -> single), with the reason"),
+    ("kernel_tier", "the kernel tier a fused stepper actually "
+                    "dispatched (resident-chunk/streaming-chunk/pair/"
+                    "single/xla) + modeled HBM bytes per step"),
+    ("autotune_record", "a sweep winner persisted to the per-device "
+                        "autotune table"),
+    ("autotune_mismatch", "an autotune-table entry was refused "
+                          "(version/flag-stale or corrupt table)"),
+    ("autotune_gc", "stale autotune entries collected"),
+    ("autotune_sweep", "one autotune sweep's totals (winner + "
+                       "candidate count)"),
+    ("autotune_warm_build", "a table-hit stepper rebuild dispatched "
+                            "with its compile-watch record — "
+                            "backend_compiles == 0 is the "
+                            "zero-extra-compiles proof"),
     # -- checkpoints (utils.checkpoint) -------------------------------------
     ("checkpoint_save", "async checkpoint write SCHEDULED (not durable)"),
     ("checkpoint_durable", "durability barrier passed; last_good advanced"),
@@ -260,6 +280,8 @@ for _name, _help in (
     ("fft_spectra", "a driver's sharded-spectra leg totals"),
     ("lint", "the static-analysis verdict of the run"),
     ("smoke_supervised_failed", "smoke: supervised payload failed"),
+    ("smoke_autotune_failed", "smoke: fused-tier/autotune payload "
+                              "failed its pins"),
     ("smoke_remesh_failed", "smoke: remesh drill failed"),
     ("smoke_service_failed", "smoke: service payload failed"),
 ):

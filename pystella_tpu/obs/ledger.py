@@ -233,6 +233,12 @@ class PerfLedger:
         self.forensic_bundles = []      # bundle paths written this run
         self.lint = None                # lint-event summary (see lint())
         self.donated_bytes = None       # aliased bytes in the step compile
+        self.kernel_tiers = []          # kernel_tier payloads (dispatch
+        #                                 record: which fused tier ran)
+        self.block_choices = []         # block_choice payloads
+        self.autotune_mismatches = []   # refused stale table entries
+        self.autotune_records = []      # autotune_record/sweep payloads
+        self.autotune_warm_builds = []  # table-hit rebuild compile proof
         self.cold_start_meta = {}       # cold_start-event payload
         self.cache_info = {}            # compile_cache-event payload
         self.warmstart_loads = []       # warmstart_load payloads
@@ -338,6 +344,16 @@ class PerfLedger:
                 led.halo_bytes_per_step = float(data["bytes_per_step"])
             elif kind == "compile":
                 led.compile_records.append(data)
+            elif kind == "kernel_tier":
+                led.kernel_tiers.append(data)
+            elif kind == "block_choice":
+                led.block_choices.append(data)
+            elif kind == "autotune_mismatch":
+                led.autotune_mismatches.append(data)
+            elif kind in ("autotune_record", "autotune_sweep"):
+                led.autotune_records.append({"kind": kind, **data})
+            elif kind == "autotune_warm_build":
+                led.autotune_warm_builds.append(data)
             elif kind == "health":
                 # sentinel health vectors (obs.sentinel): the invariant
                 # scalars become the numerics section's drift series
@@ -537,7 +553,75 @@ class PerfLedger:
                 "achieved_gbps": achieved,
                 "peak_gbps": peak,
                 "fraction_of_peak": frac,
-                "donated_bytes": self.donated_bytes}
+                "donated_bytes": self.donated_bytes,
+                "kernel_tiers": self.kernel_tier_summary()}
+
+    def kernel_tier_summary(self):
+        """The roofline's dispatch record: which fused kernel tier each
+        stepper ACTUALLY ran (``kernel_tier`` events: resident-chunk /
+        streaming-chunk / pair / single / xla, with the modeled
+        per-step lattice traffic — exact for the Pallas tiers, whose
+        kernels read every input and write every output once), the
+        chunk-vs-pair per-step HBM-traffic reduction when both tiers
+        ran in the window, and the autotune-table provenance of the
+        block choices (``block_choice`` sources + refused stale
+        entries). ``None`` when the run carried no tier telemetry."""
+        if not (self.kernel_tiers or self.block_choices
+                or self.autotune_mismatches
+                or self.autotune_warm_builds):
+            return None
+        rows = {}
+        for kt in self.kernel_tiers:
+            key = (kt.get("label"), kt.get("entrypoint"),
+                   kt.get("tier"))
+            rows[key] = kt  # last emission wins per dispatch site
+        tiers = [
+            {k: r.get(k) for k in (
+                "label", "entrypoint", "tier", "chunk_depth",
+                "bytes_per_step", "kernels_per_2_steps", "local_shape",
+                "autotune")}
+            for r in rows.values()]
+        # measured per-step traffic reduction: the chunked stepper's
+        # bytes/step against the pair-tier stepper of the same system
+        # and local shape in the same window (the smoke payload runs
+        # both back to back for exactly this comparison)
+        reduction = None
+        chunk = next((r for r in tiers
+                      if "chunk" in (r.get("tier") or "")), None)
+        if chunk is not None:
+            pair = next(
+                (r for r in tiers if r.get("tier") == "pair"
+                 and r.get("local_shape") == chunk.get("local_shape")
+                 and r.get("label") == chunk.get("label")), None)
+            cb = chunk.get("bytes_per_step")
+            pb = (pair or {}).get("bytes_per_step")
+            if (isinstance(cb, (int, float))
+                    and isinstance(pb, (int, float)) and pb):
+                reduction = {
+                    "chunk_bytes_per_step": int(cb),
+                    "pair_bytes_per_step": int(pb),
+                    "traffic_reduction": 1.0 - cb / pb}
+        sources = {}
+        for bc in self.block_choices:
+            src = bc.get("source") or "?"
+            sources[src] = sources.get(src, 0) + 1
+        tables = sorted({r.get("path") for r in self.autotune_records
+                         if r.get("path")})
+        return {
+            "dispatched": tiers,
+            "chunk_vs_pair": reduction,
+            "block_choice_sources": sources,
+            "autotune": {
+                "hits": sources.get("autotune", 0),
+                "mismatches_refused": len(self.autotune_mismatches),
+                "tables": tables,
+                # the zero-extra-backend-compiles proof: a table-hit
+                # rebuild dispatched against the warm compilation
+                # cache (last record wins)
+                "warm_build": (self.autotune_warm_builds[-1]
+                               if self.autotune_warm_builds else None),
+            },
+        }
 
     def overlap_summary(self):
         """Exposed-vs-hidden communication time of the overlapped halo
@@ -1391,6 +1475,36 @@ def render_markdown(rep):
         "not hold twice (from the step compile's alias analysis)",
         "",
     ]
+    kt = rf.get("kernel_tiers")
+    if kt:
+        lines += ["### Kernel tiers dispatched", ""]
+        for row in kt.get("dispatched") or []:
+            extra = ""
+            if row.get("chunk_depth"):
+                extra = f", depth {row['chunk_depth']}"
+            if isinstance(row.get("bytes_per_step"), (int, float)):
+                extra += (f", {row['bytes_per_step']:,.0f} lattice "
+                          "bytes/step")
+            src = (row.get("autotune") or {}).get("source")
+            if src:
+                extra += f", blocks via {src}"
+            lines.append(f"- {row.get('label')}.{row.get('entrypoint')}"
+                         f": **{row.get('tier')}**{extra}")
+        cvp = kt.get("chunk_vs_pair")
+        if cvp:
+            lines.append(
+                f"- chunk vs pair: "
+                f"{cvp['chunk_bytes_per_step']:,} vs "
+                f"{cvp['pair_bytes_per_step']:,} bytes/step -> "
+                f"{cvp['traffic_reduction']:.1%} less HBM traffic")
+        at = kt.get("autotune") or {}
+        lines.append(
+            f"- autotune: {at.get('hits', 0)} table hit(s), "
+            f"{at.get('mismatches_refused', 0)} stale entr(ies) "
+            "refused"
+            + (f", table {at['tables'][-1]}" if at.get("tables")
+               else ""))
+        lines.append("")
     lint = rep.get("lint")
     if lint:
         lines += ["## Lint", ""]

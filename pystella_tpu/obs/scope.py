@@ -79,6 +79,9 @@ for _name in (
     "collective-permute",
     # Pallas kernel dispatch
     "pallas_stencil", "pallas_resident_stencil",
+    # the whole-RK-chunk (temporal blocking) kernel dispatch and the
+    # persistent autotuner's timed candidate probes (ops.autotune)
+    "chunk_stage", "autotune_probe",
     # multigrid
     "mg_cycle", "mg_smooth", "mg_residual",
     # driver-level spans (bench smoke / example loops)

@@ -40,6 +40,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from pystella_tpu import config as _config
 from pystella_tpu import field as _field
 from pystella_tpu import step as _step
 from pystella_tpu.obs import events as _events
@@ -83,14 +84,46 @@ class FusedScalarStepper(_step.Stepper):
     :arg pair_bx, pair_by: explicit blocking for the stage-pair kernel
         (its VMEM footprint is ~2x the single-stage kernel's, so it picks
         its own default blocking; ``bx``/``by`` do not apply to it).
+    :arg chunk_stages: temporal-blocking chunk depth — an even number
+        >= 4 of consecutive RK stages advanced by ONE kernel invocation
+        while the lattice block stays in VMEM (``step()``/``multi_step``
+        dispatch chunk kernels first, then pairs, then singles). Each
+        composed stage pair widens the window halo by ``h`` (redundant
+        halo-region recompute traded for eliminated HBM round trips —
+        per-stage lattice traffic halves again vs the pair tier: 4 ->
+        2 array transfers/stage for the scalar system). Bit-exact
+        against the sequence of pair-stage kernels it replaces (the
+        deeper intermediate fields compose through the identical
+        per-element arithmetic the pair kernels materialize).
+        ``None`` (default) consults the autotune table, then
+        ``PYSTELLA_CHUNK_STAGES``; ``0`` forces the pair tier. Sharded
+        meshes, window halos beyond the 8-aligned y pad, and
+        VMEM-infeasible shapes degrade to pair kernels with a
+        ``kernel_fallback`` event (the pair tier's own fallbacks to
+        single-stage/XLA below it are unchanged).
+    :arg chunk_bx, chunk_by: explicit blocking for the chunk kernel.
+    :arg autotune: the persistent-autotuner consult policy for this
+        build: ``None`` (default) follows ``PYSTELLA_AUTOTUNE`` and the
+        default store, ``False`` skips the table, or an explicit
+        :class:`~pystella_tpu.ops.autotune.AutotuneStore` (hermetic
+        drivers/tests). A table hit supplies the hot-loop kernel's
+        blocking (and the chunk depth when ``chunk_stages`` is None);
+        stale entries are refused like stale warm-start artifacts.
     """
+
+    #: autotune-table key kind + chunk support (the scalar+GW subclass
+    #: overrides: its chunk body is not implemented — requests degrade
+    #: to the pair tier with a kernel_fallback event)
+    _autotune_kind = "fused_scalar"
+    _chunk_supported = True
 
     def __init__(self, sector, decomp, grid_shape, dx, halo_shape=2,
                  tableau=None, dtype=jnp.float32, bx=None, by=None,
                  dt=None, pair_stages=True, pair_bx=None, pair_by=None,
                  interpret=None, donate=False, resident=None,
-                 carry_dtype=None, assemble="concat", overlap=None,
-                 **kwargs):
+                 carry_dtype=None, assemble=None, overlap=None,
+                 chunk_stages=None, chunk_bx=None, chunk_by=None,
+                 autotune=None, **kwargs):
         tableau = tableau or _step.LowStorageRK54
         self._A = tableau._A
         self._B = tableau._B
@@ -157,11 +190,52 @@ class FusedScalarStepper(_step.Stepper):
         #: Validated HERE (not just in StreamingStencil) because
         #: _build_stencil treats construction ValueErrors as "no feasible
         #: blocking" and falls back — a typo would silently change tiers.
-        if assemble not in ("concat", "update"):
+        if assemble not in (None, "concat", "update"):
             raise TypeError(f"assemble must be 'concat'/'update', "
                             f"got {assemble!r}")
-        self._assemble = assemble
+        # None = defer the layout to policy (autotune table, else
+        # "concat") — an EXPLICIT request, 'concat' included, is never
+        # overridden (the chunk_stages=None-vs-0 sentinel convention)
+        self._assemble = assemble or "concat"
+
+        # persistent-autotuner consult (ops.autotune): a live-process-
+        # matching table entry supplies the hot-loop kernel's measured
+        # blocking — and the chunk depth, when the caller left it to
+        # policy — BEFORE the choose_blocks heuristic; stale entries
+        # were already refused by the store (autotune_mismatch event)
+        from pystella_tpu.ops import autotune as _autotune
+        self._autotune_entry, self._autotune_digest = _autotune.consult(
+            self._autotune_kind, self.local_shape, self.h, self.dtype,
+            self.F, gravitational_waves=hasattr(self, "n_hij"),
+            proc_shape=decomp.proc_shape,
+            carry_dtype=self._carry_dtype, store=autotune,
+            tableau=tableau.__name__)
+        entry = self._autotune_entry
+        if (entry is not None and entry.get("assemble")
+                and assemble is None):
+            # layout is part of the swept config; any explicit request
+            # beats the table
+            self._assemble = str(entry["assemble"])
+        if chunk_stages is None:
+            if entry is not None and entry.get("chunk") is not None:
+                chunk_stages = int(entry["chunk"])
+            else:
+                chunk_stages = _config.get_int("PYSTELLA_CHUNK_STAGES")
+        self._chunk_requested = int(chunk_stages or 0)
+        if self._chunk_requested and (self._chunk_requested % 2
+                                      or self._chunk_requested < 4):
+            raise ValueError(
+                f"chunk_stages must be an even number >= 4 (got "
+                f"{self._chunk_requested}); depth 2 is the pair tier "
+                "(pair_stages=True)")
+        self._chunk_bx, self._chunk_by = chunk_bx, chunk_by
+        self._chunk_call = None   # set by _maybe_build_chunk
+        self._chunk_st = None
+        self._chunk_depth = 0
+        self._tier_emitted = set()  # entrypoints that reported their tier
+
         self._build_kernels(bx, by)
+        self._maybe_build_chunk()
 
         # jitted whole-step (one XLA computation, all stages fused).
         # ``donate=True`` donates the input state buffers (halves the
@@ -188,26 +262,76 @@ class FusedScalarStepper(_step.Stepper):
     #: storage candidates; subclasses extend)
     _carry_names = frozenset({"kf", "kdfdt", "kdfp"})
 
+    def _resolve_blocks(self, kind, bx, by, stages):
+        """Where a kernel's blocking comes from, consulted BEFORE the
+        ``choose_blocks`` heuristic: explicit constructor pins, the
+        ``PYSTELLA_FORCE_BLOCKS`` override, or a live autotune-table
+        entry matching this kernel kind and chunk depth. Returns
+        ``(bx, by, source)`` with ``bx``/``by`` still ``None`` for the
+        heuristic case."""
+        if bx is not None or by is not None:
+            return bx, by, "explicit"
+        forced = _config.getenv("PYSTELLA_FORCE_BLOCKS")
+        if forced:
+            try:
+                fbx, fby = (int(v) for v in str(forced).split(","))
+            except ValueError:
+                raise ValueError(
+                    f"PYSTELLA_FORCE_BLOCKS must be 'bx,by', got "
+                    f"{forced!r}")
+            return fbx, fby, "override"
+        entry = self._autotune_entry
+        if entry is not None:
+            tuned_chunk = int(entry.get("chunk") or 0)
+            hot = (("chunk", tuned_chunk) if tuned_chunk
+                   else ("pair", 0))
+            if ((kind, stages if kind == "chunk" else 0) == hot
+                    and entry.get("bx") and entry.get("by")):
+                return int(entry["bx"]), int(entry["by"]), "autotune"
+        return None, None, "heuristic"
+
+    def _emit_block_choice(self, kind, st, source):
+        """The auditable record of what a kernel build actually chose
+        (ROADMAP: the advisor and the ledger's roofline tier rows key
+        on the same table, so advice == reality)."""
+        _events.emit(
+            "block_choice", kernel=kind,
+            stencil=type(st).__name__,
+            bx=getattr(st, "bx", None), by=getattr(st, "by", None),
+            win_halo=getattr(st, "wh", None),
+            stages=getattr(st, "stages", 1),
+            source=source, local_shape=list(self.local_shape),
+            autotune_digest=self._autotune_digest,
+            label=type(self).__name__)
+
     def _build_stencil(self, win_defs, body, out_defs, extra_defs,
-                       scalar_names, bx=None, by=None, sum_defs=None):
+                       scalar_names, bx=None, by=None, sum_defs=None,
+                       kind="stage", win_halo=None, stages=1):
         """A stage kernel: streaming VMEM-ring windows when the lattice
         admits them, else (single-device) the whole-lattice-resident
         all-roll kernel — the Z < 128 small-lattice tier (VERDICT r3
         #4). ``resident=True``/``False`` at construction forces the
-        choice."""
+        choice. Blocking resolution order: explicit ``bx``/``by`` >
+        ``PYSTELLA_FORCE_BLOCKS`` > a live autotune-table entry for the
+        hot-loop kernel > the ``choose_blocks`` heuristic; the realized
+        choice is recorded as a ``block_choice`` event either way."""
         dtypes = None
         if self._carry_dtype is not None:
             names = (set(win_defs) | set(extra_defs or {})
                      | set(out_defs)) & self._carry_names
             dtypes = {n: self._carry_dtype for n in names}
+        bx, by, source = self._resolve_blocks(kind, bx, by, stages)
         common = dict(extra_defs=extra_defs, scalar_names=scalar_names,
                       dtype=self.dtype, sum_defs=sum_defs, dtypes=dtypes)
         if not self._resident:
             try:
-                return StreamingStencil(
+                st = StreamingStencil(
                     self.local_shape, win_defs, self.h, body, out_defs,
                     bx=bx, by=by, assemble=self._assemble,
+                    win_halo=win_halo, stages=stages,
                     **self._halo_kw, **common)
+                self._emit_block_choice(kind, st, source)
+                return st
             except ValueError:
                 # no resident fallback for sharded lattices (resident
                 # taps assume LOCAL periodicity) or explicitly pinned
@@ -229,9 +353,11 @@ class FusedScalarStepper(_step.Stepper):
             _events.emit("assemble_fallback", tier="resident",
                          requested="update",
                          local_shape=self.local_shape)
-        return ResidentStencil(self.local_shape, win_defs, self.h, body,
-                               out_defs, interpret=self._interpret,
-                               **common)
+        st = ResidentStencil(self.local_shape, win_defs, self.h, body,
+                             out_defs, interpret=self._interpret,
+                             stages=stages, **common)
+        self._emit_block_choice(kind, st, source)
+        return st
 
     def _try_pair_stencil(self, make):
         """Build the stage-pair kernel, degrading to single-stage kernels
@@ -261,7 +387,8 @@ class FusedScalarStepper(_step.Stepper):
             {"f": F}, self._scalar_body,
             {"f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
             {"dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
-            ("dt", "a", "hubble", "A", "B"), bx=bx, by=by)
+            ("dt", "a", "hubble", "A", "B"), bx=bx, by=by,
+            kind="stage")
         self._scalar_call = self._make_call(
             self._scalar_st, windows=("f",),
             extra_names=("dfdt", "kf", "kdfdt"))
@@ -287,7 +414,7 @@ class FusedScalarStepper(_step.Stepper):
                     {"kdfdt": (F,)},
                     ("dt", "a1", "hubble1", "A1", "B1",
                      "a2", "hubble2", "A2", "B2"),
-                    bx=self._pair_bx, by=self._pair_by))
+                    bx=self._pair_bx, by=self._pair_by, kind="pair"))
             if self._pair_st is not None:
                 self._pair_call = self._make_call(
                     self._pair_st,
@@ -459,6 +586,300 @@ class FusedScalarStepper(_step.Stepper):
             return out
         return taps
 
+    # -- whole-RK-chunk (temporal blocking) kernels ------------------------
+    #
+    # The pair kernel composes ONE intermediate field's taps from the
+    # raw windows; the chunk kernel iterates that idea: every
+    # post-stage array (f, dfdt, kf, kdfdt) becomes a lazily-evaluated,
+    # memoized taps-like view composed from the pre-stage views by the
+    # IDENTICAL per-element arithmetic the pair kernels apply — so a
+    # depth-D kernel advances D stages in one HBM pass, bit-exact
+    # against the sequence of pair kernels it replaces (a materialized
+    # array's value at a shifted site is the same op tree the composed
+    # view evaluates there; rolls are permutations and commute with
+    # elementwise ops). The price is window width — stage j's Laplacian
+    # reaches h further than stage j-2's, so the assembled window halo
+    # is ceil(D/2)*h — and redundant halo-region recompute, which is
+    # exactly the temporal-blocking trade (PAPERS.md arxiv 2309.04671):
+    # per-stage lattice traffic drops from the pair tier's 4 array
+    # transfers to 8/D (2 at depth 4).
+
+    @staticmethod
+    def _memo_taps(compute_xy, roll):
+        """A taps-like view from an (sx, sy) -> block expression:
+        memoized per offset, z offsets as in-register rolls of the
+        offset-0 block (the ``_axpy_taps`` contract)."""
+        cache = {}
+
+        def taps(sx=0, sy=0, sz=0):
+            key = (sx, sy, sz)
+            if key in cache:
+                return cache[key]
+            if sz != 0:
+                if sx or sy:
+                    raise ValueError("taps must be axis-aligned")
+                out = roll(taps(), sz)
+            else:
+                out = compute_xy(sx, sy)
+            cache[key] = out
+            return out
+        return taps
+
+    @staticmethod
+    def _lap_at(t, roll, coefs, inv_dx2, sx, sy):
+        """The Laplacian of a taps-like view at a shifted base offset:
+        a shifted-taps adapter handed to THE :func:`ops.pallas_stencil.
+        lap_from_taps` — chunk/pair bit-exactness needs the identical
+        accumulation order, which sharing the function makes true by
+        construction. The adapter's z taps are rolls of the shifted
+        block, exactly what ``Taps`` lowers its z offsets to."""
+        def shifted(a=0, b=0, c=0):
+            if c:
+                if a or b:
+                    raise ValueError("taps must be axis-aligned")
+                return roll(t(sx, sy), c)
+            return t(sx + a, sy + b)
+        return _lap_from_taps(shifted, coefs, inv_dx2)
+
+    def _compose_scalar_stage(self, tf, tdf, tkf, tkdf, roll, dt, a,
+                              hub, A, B):
+        """One 2N-storage scalar stage as composed taps-like views —
+        the arithmetic sequence of :meth:`_scalar_body` /
+        :meth:`_scalar_pair_core`, evaluated lazily at any offset."""
+        inv_dx2 = [1.0 / d**2 for d in self.dx]
+        coefs = _lap_coefs[self.h]
+        kf1 = self._memo_taps(
+            lambda sx, sy: A * tkf(sx, sy) + dt * tdf(sx, sy), roll)
+        f1 = self._memo_taps(
+            lambda sx, sy: tf(sx, sy) + B * kf1(sx, sy), roll)
+        kdf1 = self._memo_taps(
+            lambda sx, sy: A * tkdf(sx, sy) + dt * (
+                self._lap_at(tf, roll, coefs, inv_dx2, sx, sy)
+                - 2 * hub * tdf(sx, sy)
+                - a * a * self._dV(tf(sx, sy), a, hub)), roll)
+        df1 = self._memo_taps(
+            lambda sx, sy: tdf(sx, sy) + B * kdf1(sx, sy), roll)
+        return f1, df1, kf1, kdf1
+
+    def _chunk_body(self, taps, extras, scalars, depth):
+        """``depth`` consecutive scalar stages in ONE pass over HBM.
+        With reduced-precision carries, the composed carry views are
+        quantized at every interior PAIR boundary — exactly where the
+        pair-kernel sequence materializes (and therefore rounds) them —
+        so the chunk stays bit-exact against that sequence in either
+        precision mode."""
+        tf, tdf = taps["f"], taps["dfdt"]
+        tkf, tkdf = taps["kf"], taps["kdfdt"]
+        roll = tf.roll
+        dt = scalars["dt"]
+        cd = self._carry_dtype
+        for j in range(depth):
+            i = j + 1
+            tf, tdf, tkf, tkdf = self._compose_scalar_stage(
+                tf, tdf, tkf, tkdf, roll, dt,
+                scalars[f"a{i}"], scalars[f"hubble{i}"],
+                scalars[f"A{i}"], scalars[f"B{i}"])
+            if cd is not None and j % 2 == 1 and j < depth - 1:
+                tkf = self._memo_taps(
+                    lambda sx, sy, t=tkf: t(sx, sy).astype(cd), roll)
+                tkdf = self._memo_taps(
+                    lambda sx, sy, t=tkdf: t(sx, sy).astype(cd), roll)
+        return {"f": tf(), "dfdt": tdf(), "kf": tkf(), "kdfdt": tkdf()}
+
+    def _chunk_fallback(self, reason):
+        """The first rung of the fallback ladder (chunk -> pair ->
+        single -> XLA): log it — a silently-degraded tier is exactly
+        what the roofline accounting must not hide."""
+        import warnings
+        to = "pair" if self._pair_call is not None else "single"
+        warnings.warn(
+            f"whole-RK-chunk fusion disabled ({reason}); step() will "
+            f"run {to}-stage fused kernels", stacklevel=3)
+        _events.emit("kernel_fallback", tier="chunk", to=to,
+                     reason=str(reason),
+                     local_shape=list(self.local_shape),
+                     label=type(self).__name__)
+
+    def _maybe_build_chunk(self):
+        """Build the requested whole-RK-chunk kernel, degrading to the
+        pair tier (``kernel_fallback`` event) for sharded meshes,
+        window halos beyond the 8-aligned y pad, and VMEM-infeasible
+        shapes. Explicitly pinned ``chunk_bx``/``chunk_by`` propagate
+        construction errors instead (a pinned config must not silently
+        change tiers)."""
+        depth = self._chunk_requested
+        if not depth:
+            return
+        if not self._chunk_supported:
+            self._chunk_fallback(
+                f"no chunk body for {type(self).__name__}")
+            return
+        if self._px > 1 or self._py > 1:
+            # the halo exchange would have to move ceil(depth/2)*h-wide
+            # slabs per chunk (and the overlap split does not compose
+            # with composed-stage windows) — the sharded hot loop stays
+            # on the pair tier
+            self._chunk_fallback(
+                f"sharded mesh ({self._px},{self._py}): chunk windows "
+                "need ceil(depth/2)*h-wide halos")
+            return
+        if self._A[0] != 0 and depth > self.num_stages:
+            self._chunk_fallback(
+                f"tableau A[0] != 0: a depth-{depth} chunk would cross "
+                "a step boundary whose k-carry reset is not a no-op")
+            return
+        F = self.F
+        win_halo = (depth // 2) * self.h
+        try:
+            self._chunk_st = self._build_stencil(
+                {"f": F, "dfdt": F, "kf": F, "kdfdt": F},
+                lambda t, e, s: self._chunk_body(t, e, s, depth),
+                {"f": (F,), "dfdt": (F,), "kf": (F,), "kdfdt": (F,)},
+                {},
+                ("dt",) + tuple(
+                    f"{name}{i}" for i in range(1, depth + 1)
+                    for name in ("a", "hubble", "A", "B")),
+                bx=self._chunk_bx, by=self._chunk_by, kind="chunk",
+                win_halo=win_halo, stages=depth)
+        except ValueError as e:
+            if self._chunk_bx is not None or self._chunk_by is not None:
+                raise
+            self._chunk_fallback(str(e))
+            return
+        self._chunk_call = self._make_call(
+            self._chunk_st, windows=("f", "dfdt", "kf", "kdfdt"),
+            extra_names=())
+        self._chunk_depth = depth
+
+    def _check_chunk(self, stages):
+        if self._chunk_call is None:
+            raise RuntimeError(
+                "whole-RK-chunk fusion is not available on this "
+                "stepper (chunk_stages unset/0, an infeasible shape, "
+                "or a sharded mesh); use stage_pair()/stage()/step()")
+        if len(stages) != self._chunk_depth:
+            raise ValueError(
+                f"stage_chunk takes exactly {self._chunk_depth} stage "
+                f"indices (got {len(stages)})")
+        for prev, cur in zip(stages, stages[1:]):
+            if cur < prev and self._A[cur] != 0:
+                raise ValueError(
+                    f"cross-boundary chunking needs A[{cur}] == 0 so "
+                    "the step-boundary k-carry reset is a no-op; this "
+                    f"tableau has A[{cur}] = {self._A[cur]}")
+
+    def stage_chunk(self, stages, carry, t, dt, rhs_args_seq):
+        """Run the listed stages (``len == chunk_stages``) as ONE
+        resident kernel invocation. ``rhs_args_seq`` supplies each
+        stage's expansion scalars; stage indices may wrap to the next
+        step exactly like :meth:`stage_pair` (gated on the wrapped
+        stage's ``A == 0``)."""
+        stages = list(stages)
+        self._check_chunk(stages)
+        state, k = carry
+        scalars = {"dt": dt}
+        for i, (s, ra) in enumerate(zip(stages, rhs_args_seq), 1):
+            ra = ra or {}
+            scalars[f"a{i}"] = ra.get("a", 1.0)
+            scalars[f"hubble{i}"] = ra.get("hubble", 0.0)
+            scalars[f"A{i}"] = self._A[s]
+            scalars[f"B{i}"] = self._B[s]
+        with trace_scope("chunk_stage"):
+            outs = self._chunk_call(
+                {"f": state["f"], "dfdt": state["dfdt"],
+                 "kf": k["f"], "kdfdt": k["dfdt"]},
+                scalars, {})
+        return ({"f": outs["f"], "dfdt": outs["dfdt"]},
+                {"f": outs["kf"], "dfdt": outs["kdfdt"]})
+
+    # -- kernel-tier accounting (the roofline's dispatch record) -----------
+
+    @staticmethod
+    def _stencil_bytes(st):
+        """Exact per-invocation HBM traffic of one streaming/resident
+        kernel: every windowed/extra input is read once, every output
+        written once — that is the design invariant of the Pallas tier,
+        so this is a measurement of the kernel structure, not a guess."""
+        sites = int(np.prod(st.lattice_shape))
+        total = 0
+        for name, comps in st.win_defs.items():
+            total += comps * sites * st.dtypes.get(name,
+                                                   st.dtype).itemsize
+        for defs in (st.extra_defs, st.out_defs):
+            for name, lead in defs.items():
+                n = int(np.prod(lead)) if lead else 1
+                total += n * sites * st.dtypes.get(name,
+                                                   st.dtype).itemsize
+        return total
+
+    def kernel_tier_report(self):
+        """Which kernel tier the hot loop (``multi_step``) dispatches
+        and the per-step lattice traffic it implies — the record the
+        ledger's roofline section reports per run. The consumption
+        model mirrors ``_multi_step_impl`` over one even-step period
+        (chunks first, then pairs, then singles, crossing step
+        boundaries when ``A[0] == 0``)."""
+        from pystella_tpu.ops.pallas_stencil import ResidentStencil \
+            as _Res
+        D = self._chunk_depth if self._chunk_call is not None else 0
+        single_st = getattr(self, "_scalar_st", None) or \
+            getattr(self, "_both_st", None)
+        bytes_total = 0
+        kernels = {}
+
+        def consume(n):
+            nonlocal bytes_total
+            i = 0
+            while D and i + D <= n:
+                bytes_total += self._stencil_bytes(self._chunk_st)
+                kernels["chunk"] = kernels.get("chunk", 0) + 1
+                i += D
+            while self._pair_call is not None and i + 1 < n:
+                bytes_total += self._stencil_bytes(self._pair_st)
+                kernels["pair"] = kernels.get("pair", 0) + 1
+                i += 2
+            while i < n:
+                bytes_total += self._stencil_bytes(single_st)
+                kernels["single"] = kernels.get("single", 0) + 1
+                i += 1
+
+        if self._A[0] == 0:
+            consume(2 * self.num_stages)  # crossing step boundaries
+        else:
+            consume(self.num_stages)      # per-step k-carry reset
+            consume(self.num_stages)
+        if D:
+            tier = ("resident-chunk"
+                    if isinstance(self._chunk_st, _Res)
+                    else "streaming-chunk")
+        elif self._pair_call is not None:
+            tier = "pair"
+        else:
+            tier = "single"
+        return {
+            "tier": tier,
+            "chunk_depth": D or None,
+            "kernels_per_2_steps": kernels,
+            "bytes_per_step": bytes_total // 2,
+            "local_shape": list(self.local_shape),
+            "autotune": {"digest": self._autotune_digest,
+                         "hit": self._autotune_entry is not None,
+                         "source": ("autotune"
+                                    if self._autotune_entry is not None
+                                    else "heuristic")},
+        }
+
+    def _emit_tier(self, entrypoint):
+        """One ``kernel_tier`` event per (stepper, entrypoint), emitted
+        at first dispatch — the ledger's record of the tier actually
+        run, not merely built."""
+        if entrypoint in self._tier_emitted:
+            return
+        self._tier_emitted.add(entrypoint)
+        _events.emit("kernel_tier", entrypoint=entrypoint,
+                     label=type(self).__name__,
+                     **self.kernel_tier_report())
+
     def _scalar_pair_core(self, taps, extras, scalars):
         """Two consecutive 2N-storage scalar stages in one HBM pass;
         returns the four outputs plus the stage-1 field's composed taps
@@ -548,7 +969,7 @@ class FusedScalarStepper(_step.Stepper):
                 ("dt", "a", "hubble", "A", "B"),
                 bx=getattr(self._scalar_st, "bx", None),
                 by=getattr(self._scalar_st, "by", None),
-                sum_defs={"esums": 2 * F + 1})
+                sum_defs={"esums": 2 * F + 1}, kind="energy")
             self._es_call = self._make_call(
                 st, windows=("f",), extra_names=("dfdt", "kf", "kdfdt"))
         return self._es_call
@@ -613,6 +1034,11 @@ class FusedScalarStepper(_step.Stepper):
     def _step_impl(self, state, t, dt, rhs_args):
         carry = self.init_carry(state)
         s = 0
+        D = self._chunk_depth if self._chunk_call is not None else 0
+        while D and s + D <= self.num_stages:
+            carry = self.stage_chunk(
+                list(range(s, s + D)), carry, t, dt, [rhs_args] * D)
+            s += D
         if self._pair_call is not None:
             while s + 1 < self.num_stages:
                 carry = self.stage_pair(s, carry, t, dt, rhs_args)
@@ -633,13 +1059,20 @@ class FusedScalarStepper(_step.Stepper):
                 return rhs_args
             return {**rhs_args, **{n: v[i] for n, v in rhs_seq.items()}}
 
-        if self._pair_call is None or self._A[0] != 0:
-            # no cross-boundary pairing possible: sequential steps, each
+        D = self._chunk_depth if self._chunk_call is not None else 0
+        if ((self._pair_call is None and not D) or self._A[0] != 0):
+            # no cross-boundary fusion possible: sequential steps, each
             # with its own k-carry reset (a tableau with A[0] != 0 NEEDS
-            # the per-step zeros), pairing within the step when possible
+            # the per-step zeros), chunking/pairing within the step
+            # when possible
             for step in range(nsteps):
                 carry = self.init_carry(state)
                 s, base = 0, step * nstages
+                while D and s + D <= nstages:
+                    carry = self.stage_chunk(
+                        list(range(s, s + D)), carry, t, dt,
+                        [args_at(base + s + j) for j in range(D)])
+                    s += D
                 if self._pair_call is not None:
                     while s + 1 < nstages:
                         carry = self.stage_pair(
@@ -654,10 +1087,15 @@ class FusedScalarStepper(_step.Stepper):
         carry = self.init_carry(state)
         flat = [s for _ in range(nsteps) for s in range(nstages)]
         i = 0
-        # pair across step boundaries: the stage-0 update multiplies
-        # the stale k-carry by A[0] == 0, so skipping the per-step
-        # zero-reset is bit-exact
-        while i + 1 < len(flat):
+        # chunk/pair across step boundaries: the stage-0 update
+        # multiplies the stale k-carry by A[0] == 0, so skipping the
+        # per-step zero-reset is bit-exact
+        while D and i + D <= len(flat):
+            carry = self.stage_chunk(
+                flat[i:i + D], carry, t, dt,
+                [args_at(i + j) for j in range(D)])
+            i += D
+        while self._pair_call is not None and i + 1 < len(flat):
             carry = self.stage_pair(flat[i], carry, t, dt, args_at(i),
                                     rhs_args2=args_at(i + 1),
                                     s2=flat[i + 1])
@@ -752,12 +1190,14 @@ class FusedScalarStepper(_step.Stepper):
                         f"{self.num_stages} stages = {nflat})")
         fn = self._multi_jit(nsteps, rhs_seq, sentinel)
         _metrics.counter("steps").inc(nsteps)
+        self._emit_tier("multi_step")
         return fn(state, t=t, dt=dt, rhs_args=rhs_args or {},
                   rhs_seq=rhs_seq or {})
 
     def step(self, state, t=0.0, dt=None, rhs_args=None):
         dt = dt if dt is not None else self.dt
         _metrics.counter("steps").inc()
+        self._emit_tier("step")
         return self._jit_step(state, t, dt, rhs_args or {})
 
     # -- deferred-drag coupled pair kernels --------------------------------
@@ -904,7 +1344,8 @@ class FusedScalarStepper(_step.Stepper):
             win_defs,
             lambda t, e, s: self._deferred_body(t, e, s, in_deferred),
             self._def_out_defs(), extra_defs, scalar_names,
-            sum_defs={"esums1": 2 * F + 1, "esums2": 2 * F + 1})
+            sum_defs={"esums1": 2 * F + 1, "esums2": 2 * F + 1},
+            kind="coupled_pair")
         return self._make_call(st, windows=tuple(win_defs),
                                extra_names=tuple(extra_defs))
 
@@ -1175,6 +1616,12 @@ class FusedPreheatStepper(FusedScalarStepper):
     _carry_names = frozenset({"kf", "kdfdt", "kdfp",
                               "khij", "kdhijdt", "kdhp"})
 
+    #: autotune entries for the scalar+GW system key separately; the
+    #: whole-RK-chunk body is scalar-only so far — a chunk_stages
+    #: request here degrades to the pair tier (kernel_fallback event)
+    _autotune_kind = "fused_preheat"
+    _chunk_supported = False
+
     def __init__(self, sector, gw_sector, decomp, grid_shape, dx,
                  halo_shape=2, tableau=None, dtype=jnp.float32,
                  bx=None, by=None, dt=None, **kwargs):
@@ -1204,7 +1651,8 @@ class FusedPreheatStepper(FusedScalarStepper):
              "hij": (H,), "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
             {"dfdt": (F,), "kf": (F,), "kdfdt": (F,),
              "dhijdt": (H,), "khij": (H,), "kdhijdt": (H,)},
-            ("dt", "a", "hubble", "A", "B"), bx=bx, by=by)
+            ("dt", "a", "hubble", "A", "B"), bx=bx, by=by,
+            kind="stage")
         self._both_call = self._make_call(
             self._both_st, windows=("f", "hij"),
             extra_names=("dfdt", "kf", "kdfdt",
@@ -1225,7 +1673,7 @@ class FusedPreheatStepper(FusedScalarStepper):
                     {"kdfdt": (F,), "kdhijdt": (H,)},
                     ("dt", "a1", "hubble1", "A1", "B1",
                      "a2", "hubble2", "A2", "B2"),
-                    bx=self._pair_bx, by=self._pair_by))
+                    bx=self._pair_bx, by=self._pair_by, kind="pair"))
             if self._pair_st is not None:
                 self._pair_call = self._make_call(
                     self._pair_st,
@@ -1458,7 +1906,7 @@ class FusedPreheatStepper(FusedScalarStepper):
                 ("dt", "a", "hubble", "A", "B"),
                 bx=getattr(self._both_st, "bx", None),
                 by=getattr(self._both_st, "by", None),
-                sum_defs={"esums": 2 * F + 1})
+                sum_defs={"esums": 2 * F + 1}, kind="energy")
             self._es_call = self._make_call(
                 st, windows=("f", "hij"),
                 extra_names=("dfdt", "kf", "kdfdt",

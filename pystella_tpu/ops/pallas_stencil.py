@@ -48,8 +48,9 @@ from pystella_tpu.obs.scope import trace_scope
 
 __all__ = ["StreamingStencil", "ResidentStencil", "OverlapStreamingStencil",
            "Taps", "HY", "LANE",
-           "choose_blocks", "sharded_halo", "lap_from_taps",
-           "grad_from_taps", "vmem_limit_bytes", "VMEM_LIMIT_BYTES"]
+           "choose_blocks", "feasible_blocks", "sharded_halo",
+           "lap_from_taps", "grad_from_taps", "vmem_limit_bytes",
+           "VMEM_LIMIT_BYTES"]
 
 #: aligned y-halo width (one sublane tile); must be >= the stencil radius
 HY = 8
@@ -121,10 +122,10 @@ def _rem(a, m):
 
 
 def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
-                  budget=None):
+                  budget=None, win_halo=None, stages=1):
     """Pick ``(bx, by)`` fitting the VMEM budget: the window ring, the
     double-buffered extra inputs / outputs, and ~3 window-sized compute
-    temporaries.
+    temporaries per fused stage.
 
     Preference (measured on v5e, 512^3/128^3 fused RK54 sweeps): the
     largest feasible ``by`` (fewer per-stage pallas_calls, wider DMA
@@ -136,27 +137,34 @@ def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
     ``vmem_limit_bytes`` request raises the real ceiling to
     ``PYSTELLA_VMEM_LIMIT_MB`` (100 MB), so larger budgets are now
     *compilable* — the measured preference for small blocks keeps the
-    conservative default until a sweep shows bigger wins
-    (bench_results/r05_pair_sweep.py)."""
+    conservative default until the persistent autotuner
+    (:mod:`pystella_tpu.ops.autotune`) records a sweep winner for the
+    shape, which kernel builds then consult before this heuristic.
+
+    ``win_halo`` is the assembled window's halo width (defaults to the
+    stencil radius ``h``); temporal-blocking chunk kernels pass
+    ``ceil(depth/2) * h`` — each stage pair composed in-register reaches
+    one radius further into the window — together with ``stages``, which
+    scales the compute-temporary share of the model (composed stages
+    keep ~3 extra window-sized live values each)."""
     if budget is None:
         budget = int(_config.get_float("PYSTELLA_BLOCK_BUDGET_MB") * 2**20)
+    wh = h if win_halo is None else int(win_halo)
+    if wh < h:
+        raise ValueError(f"win_halo {wh} below stencil radius {h}")
+    if wh > HY:
+        raise ValueError(
+            f"win_halo {wh} exceeds the aligned y-halo width {HY}: no "
+            "feasible streaming blocking (shrink the chunk depth or "
+            "use the pair/single-stage kernels)")
     X, Y, Z = lattice_shape
-    best = None
-    for by in (256, 128, 64, 32, 16, 8):
-        if by > Y or Y % by:
-            continue
-        for bx in (1, 2, 4, 8, 16):
-            if bx > X or X % bx or bx < h:
-                continue
-            byw = by + 2 * HY
-            win = n_comp * _RING * bx * byw * Z * itemsize
-            temps = 3 * n_comp * (bx + 2 * h) * byw * Z * itemsize
-            io = 2 * (n_extra + n_out) * bx * by * Z * itemsize
-            if win + temps + io <= budget:
-                best = (bx, by)
-                break  # smallest feasible bx for this by
-        if best is not None:
-            break  # largest feasible by wins
+    # ONE cost model: the heuristic is simply the autotuner candidate
+    # list's preferred (first) entry, so the sweep can never propose a
+    # config this builder would reject — nor vice versa
+    feasible = feasible_blocks(n_comp, lattice_shape, h, itemsize,
+                               n_extra, n_out, budget=budget,
+                               win_halo=win_halo, stages=stages)
+    best = feasible[0] if feasible else None
     if best is None:
         if Y % 8:
             # the streaming kernel's y-slab math assumes by >= the 8-aligned
@@ -180,18 +188,50 @@ def choose_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
     return best
 
 
+def feasible_blocks(n_comp, lattice_shape, h, itemsize, n_extra, n_out,
+                    budget=None, win_halo=None, stages=1):
+    """Every ``(bx, by)`` the :func:`choose_blocks` VMEM model admits,
+    heuristic-preferred order first — the candidate generator the
+    persistent autotuner (:mod:`pystella_tpu.ops.autotune`) sweeps
+    instead of re-deriving the feasibility rules."""
+    if budget is None:
+        budget = int(_config.get_float("PYSTELLA_BLOCK_BUDGET_MB") * 2**20)
+    wh = h if win_halo is None else int(win_halo)
+    if wh < h or wh > HY:
+        return []
+    X, Y, Z = lattice_shape
+    out = []
+    for by in (256, 128, 64, 32, 16, 8):
+        if by > Y or Y % by:
+            continue
+        for bx in (1, 2, 4, 8, 16):
+            if bx > X or X % bx or bx < wh:
+                continue
+            byw = by + 2 * HY
+            win = n_comp * _RING * bx * byw * Z * itemsize
+            temps = (3 * int(stages) * n_comp * (bx + 2 * wh) * byw * Z
+                     * itemsize)
+            io = 2 * (n_extra + n_out) * bx * by * Z * itemsize
+            if win + temps + io <= budget:
+                out.append((bx, by))
+    return out
+
+
 class Taps:
     """Stencil-tap accessor handed to kernel bodies.
 
     ``taps(sx, sy, sz)`` returns the windowed field shifted by the given
-    static offsets, shaped ``(C, bx, by, Z)``. ``|sx|, |sy| <= h``;
-    ``sz`` may only be nonzero alone (axis-aligned centered-difference
-    taps); z wraps periodically (whole axis in VMEM), x/y shifts read the
-    window halo."""
+    static offsets, shaped ``(C, bx, by, Z)``. ``|sx| <= wh`` (the
+    window halo width — the stencil radius ``h`` for single/pair
+    kernels, ``ceil(depth/2) * h`` for temporal-blocking chunk
+    kernels), ``|sy| <= HY``; ``sz`` may only be nonzero alone
+    (axis-aligned centered-difference taps); z wraps periodically
+    (whole axis in VMEM), x/y shifts read the window halo."""
 
-    def __init__(self, w, h, bx, by, Z, interpret):
+    def __init__(self, w, h, bx, by, Z, interpret, wh=None):
         self._w = w
         self._h, self._bx, self._by, self._Z = h, bx, by, Z
+        self._wh = h if wh is None else wh
         self._interpret = interpret
         self._cache = {}
 
@@ -199,13 +239,14 @@ class Taps:
         key = (sx, sy, sz)
         if key in self._cache:
             return self._cache[key]
-        h, bx, by, Z = self._h, self._bx, self._by, self._Z
+        wh, bx, by, Z = self._wh, self._bx, self._by, self._Z
         if sz != 0:
             if sx or sy:
                 raise ValueError("taps must be axis-aligned")
             out = self.roll(self(), sz)
         else:
-            out = self._w[:, h + sx:h + sx + bx, HY + sy:HY + sy + by, :]
+            out = self._w[:, wh + sx:wh + sx + bx,
+                          HY + sy:HY + sy + by, :]
         self._cache[key] = out
         return out
 
@@ -307,7 +348,7 @@ class ResidentStencil:
     def __init__(self, lattice_shape, win_defs, h, body, out_defs,
                  extra_defs=None, scalar_names=(), dtype=jnp.float32,
                  interpret=None, sum_defs=None, budget=64 * 2**20,
-                 dtypes=None):
+                 dtypes=None, stages=1):
         self.lattice_shape = X, Y, Z = tuple(int(s) for s in lattice_shape)
         if not isinstance(win_defs, dict):
             win_defs = {"f": int(win_defs)}
@@ -337,8 +378,10 @@ class ResidentStencil:
         # budget ~(6h + 2) whole-lattice temporaries per window
         # component rather than a flat 3, so the Python-level gate
         # fires before Mosaic's VMEM allocator rejects the kernel with
-        # no fallback (ADVICE r4).
-        ntemp = 6 * self.h + 2
+        # no fallback (ADVICE r4). Multi-stage (temporal-blocking)
+        # bodies memoize a comparable set of composed whole-lattice
+        # values per fused stage — the ``stages`` factor.
+        ntemp = (6 * self.h + 2) * max(1, int(stages))
         need = (nio + ntemp * nwin) * X * Y * Z * self.dtype.itemsize
         if need > budget:
             raise ValueError(
@@ -461,9 +504,28 @@ class StreamingStencil:
                  extra_defs=None, scalar_names=(), dtype=jnp.float32,
                  bx=None, by=None, x_halo=False, y_halo=False,
                  interpret=None, sum_defs=None, dtypes=None,
-                 assemble="concat"):
+                 assemble="concat", win_halo=None, stages=1):
         if h > HY:
             raise ValueError(f"stencil radius {h} exceeds aligned halo {HY}")
+        #: fused-stage count of the body (1 single, 2 pair, >=4 chunk):
+        #: scales the compute-temporary share of the default-blocking
+        #: VMEM model — composed stages keep extra window-sized values
+        #: live
+        self.stages = max(1, int(stages))
+        #: assembled window halo width: the stencil radius for
+        #: single/pair kernels; temporal-blocking chunk kernels widen it
+        #: to ``ceil(depth/2) * h`` so composed deeper-stage taps stay
+        #: in-window (the recompute-for-traffic trade of
+        #: doc/performance.md "Temporal blocking")
+        self.wh = int(h if win_halo is None else win_halo)
+        if self.wh < int(h):
+            raise ValueError(
+                f"win_halo {self.wh} below stencil radius {h}")
+        if self.wh > HY:
+            raise ValueError(
+                f"win_halo {self.wh} exceeds the aligned y-halo width "
+                f"{HY}: the y-window pad cannot cover the composed-stage "
+                "taps; use a shallower chunk or the pair kernels")
         self.lattice_shape = X, Y, Z = tuple(int(s) for s in lattice_shape)
         if not isinstance(win_defs, dict):
             win_defs = {"f": int(win_defs)}
@@ -493,14 +555,17 @@ class StreamingStencil:
                 sum(int(np.prod(s)) if s else 1
                     for s in self.extra_defs.values()),
                 sum(int(np.prod(s)) if s else 1
-                    for s in self.out_defs.values()))
+                    for s in self.out_defs.values()),
+                win_halo=self.wh, stages=self.stages)
             bx = bx if bx is not None else cbx
             by = by if by is not None else cby
         if X % bx or Y % by:
             raise ValueError(
                 f"block ({bx},{by}) must divide lattice ({X},{Y})")
-        if bx < self.h and X // bx > 1:
-            raise ValueError(f"bx={bx} must be >= stencil radius {self.h}")
+        if bx < self.wh and X // bx > 1:
+            raise ValueError(
+                f"bx={bx} must be >= the window halo {self.wh} (ring "
+                "slots supply the halo rows)")
         self.bx, self.by = int(bx), int(by)
         self.x_halo = bool(x_halo)
         self.y_halo = bool(y_halo)
@@ -604,7 +669,8 @@ class StreamingStencil:
 
     def _run_body(self, ws, scalar_refs, extra_refs, out_refs):
         X, Y, Z = self.lattice_shape
-        taps = {n: Taps(w, self.h, self.bx, self.by, Z, self.interpret)
+        taps = {n: Taps(w, self.h, self.bx, self.by, Z, self.interpret,
+                        wh=self.wh)
                 for n, w in zip(self.win_defs, ws)}
         if self.single_window:
             taps = next(iter(taps.values()))
@@ -644,7 +710,7 @@ class StreamingStencil:
         if self.x_halo:
             return self._build_xhalo(j)
         X, Y, Z = self.lattice_shape
-        h, bx, by = self.h, self.bx, self.by
+        h, bx, by = self.wh, self.bx, self.by
         byw = by + 2 * HY
         nbx = X // bx
         R = _RING
@@ -721,10 +787,10 @@ class StreamingStencil:
         )
 
     def _build_xhalo(self, j):
-        """Sharded-x variant: input rows are pre-padded ``(C, X+2h, Y, Z)``;
-        each program DMAs its own haloed window (double-buffered)."""
+        """Sharded-x variant: input rows are pre-padded ``(C, X+2wh, Y,
+        Z)``; each program DMAs its own haloed window (double-buffered)."""
         X, Y, Z = self.lattice_shape
-        h, bx, by = self.h, self.bx, self.by
+        h, bx, by = self.wh, self.bx, self.by
         bxw, byw = bx + 2 * h, by + 2 * HY
         nbx = X // bx
         ypieces = self._y_pieces(j)
@@ -798,7 +864,8 @@ class StreamingStencil:
             scalar_names=self.scalar_names, dtype=self.dtype,
             bx=bx, by=by, x_halo=self.x_halo, y_halo=self.y_halo,
             interpret=self.interpret, sum_defs=self.sum_defs,
-            dtypes=self.dtypes, assemble=self.assemble)
+            dtypes=self.dtypes, assemble=self.assemble,
+            win_halo=self.wh, stages=self.stages)
 
     # -- invocation --------------------------------------------------------
 

@@ -624,9 +624,7 @@ class ScenarioService:
                     return None
         finally:
             self._active_lease = None
-        backend_compiles = int(w.cache_misses) if (
-            w.cache_hits or w.cache_misses) else (
-            1 if w.compile_seconds > 0 else 0)
+        backend_compiles = w.backend_compiles
         replayed = (rep["steps_replayed"] * self.chunk
                     * max(1, len(lease.active_members())
                           + len(lease.finished)))

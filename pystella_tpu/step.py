@@ -170,6 +170,15 @@ class Stepper:
         The whole step (all stages + right-hand sides) runs as a single
         jit-compiled computation."""
         dt = dt if dt is not None else self.dt
+        if not getattr(self, "_tier_emitted_xla", False):
+            # the roofline's dispatch record: the generic stepper IS the
+            # XLA rung of the fused tiers' fallback ladder (the fused
+            # steppers emit their own kernel_tier with the Pallas tier
+            # actually dispatched; see ops/fused.py)
+            self._tier_emitted_xla = True
+            from pystella_tpu.obs import events as _events
+            _events.emit("kernel_tier", entrypoint="step", tier="xla",
+                         label=type(self).__name__)
         return self._jit_step(state, t, dt, rhs_args or {})
 
     def _health_jit(self, sentinel):

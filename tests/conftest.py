@@ -47,6 +47,13 @@ os.environ["PYSTELLA_BENCH_PLATFORM"] = (
 # (the bit-exactness contract means results must be identical).
 os.environ.setdefault("PYSTELLA_HALO_OVERLAP", "0")
 
+# Pin the autotune-table consult OFF suite-wide: ambient fused-stepper
+# builds must be hermetic (a table a previous test — or a developer's
+# local sweep — left under bench_results/ must not silently change the
+# blockings the suite compiles). tests/test_autotune.py opts in with
+# explicit per-constructor stores, which beat this env.
+os.environ.setdefault("PYSTELLA_AUTOTUNE", "0")
+
 import common  # noqa: F401, E402  (side effect: forces the platform)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -881,3 +881,261 @@ def test_fused_resident_auto_small_y(decomp):
         (1,) + grid_shape)), "dfdt": _arr(np.zeros((1,) + grid_shape))}
     out = st.step(state, 0.0, 0.01, {"a": 1.0, "hubble": 0.0})
     assert np.all(np.isfinite(np.asarray(out["f"])))
+
+
+# -- whole-RK-chunk (temporal blocking) tier --------------------------------
+
+def test_chunk_stages_match_pair_stages(decomp):
+    """THE chunk-tier pin: a depth-4 whole-RK-chunk kernel advances four
+    stages in one HBM pass by composing the intermediate arrays' taps
+    in-register; its arithmetic sequence per element is IDENTICAL to
+    the pair-kernel sequence it replaces, so multi_step must be
+    bit-exact (not merely close) against the pair tier — across step
+    boundaries included (nsteps=2 consumes 10 flat RK54 stages as
+    chunk+chunk+pair; nsteps=3 exercises the odd tail)."""
+    grid_shape = (16, 16, 16)
+    h, dx = 2, (0.3, 0.25, 0.2)
+    dt = 0.01
+    rng = np.random.default_rng(17)
+    state = {
+        "f": _arr(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
+    }
+    args = {"a": 1.3, "hubble": 0.21}
+
+    sector = ps.ScalarSector(2, potential=_potential)
+    kw = dict(dtype=jnp.float64, **_XKW)
+    pair = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                              bx=4, by=8, **kw)
+    chunk = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                               chunk_stages=4, chunk_bx=4, chunk_by=8,
+                               **kw)
+    assert chunk._chunk_call is not None and chunk._chunk_depth == 4
+    assert pair._chunk_call is None
+    # the chunk window reaches ceil(4/2)*h = 2h into the halo
+    assert chunk._chunk_st.wh == 2 * h
+
+    # nsteps=2 consumes all 10 flat stages as chunk+chunk+pair, with
+    # the second chunk CROSSING the step boundary (its stage list is
+    # [4, 0, 1, 2] — the A[0] == 0 no-op k-carry reset)
+    ref = pair.multi_step(
+        {k: _arr(np.asarray(v)) for k, v in state.items()},
+        2, 0.0, dt, args)
+    got = chunk.multi_step(
+        {k: _arr(np.asarray(v)) for k, v in state.items()},
+        2, 0.0, dt, args)
+    for name in ("f", "dfdt"):
+        assert np.array_equal(np.asarray(got[name]),
+                              np.asarray(ref[name])), \
+            f"{name}: chunk diverges from pair sequence"
+
+    # the within-step consumption (chunk + trailing single, the step()
+    # shape) pinned EAGERLY at one f64 ulp: each eager dispatch is its
+    # own compiled program, and the backend contracts FMAs differently
+    # in the one-kernel chunk body than in the two pair bodies (the
+    # jitted multi_step comparison above, where both tiers sit in one
+    # program context, stays exactly bitwise)
+    cp = pair.init_carry(state)
+    cp = pair.stage_pair(0, cp, 0.0, dt, args)
+    cp = pair.stage_pair(2, cp, 0.0, dt, args)
+    cp = pair.stage(4, cp, 0.0, dt, args)
+    cc = chunk.init_carry(state)
+    cc = chunk.stage_chunk([0, 1, 2, 3], cc, 0.0, dt, [args] * 4)
+    cc = chunk.stage(4, cc, 0.0, dt, args)
+    for part in (0, 1):
+        for name in ("f", "dfdt"):
+            a = np.asarray(cp[part][name])
+            b = np.asarray(cc[part][name])
+            scale = np.max(np.abs(a)) or 1.0
+            assert np.max(np.abs(a - b)) / scale < 1e-14, \
+                f"{name}: within-step chunk diverges"
+
+    # the dispatch record the roofline section ingests: chunked tier,
+    # and strictly less modeled lattice traffic than the pair tier
+    trep_c = chunk.kernel_tier_report()
+    trep_p = pair.kernel_tier_report()
+    assert trep_c["tier"].endswith("-chunk")
+    assert trep_p["tier"] == "pair"
+    assert trep_c["bytes_per_step"] < trep_p["bytes_per_step"]
+
+
+@pytest.mark.slow
+def test_chunk_multi_step_odd_and_jit_step(decomp):
+    """The heavier chunk-tier parity variants: an odd step count (the
+    chunk/pair/single tail interleaving differs from nsteps=2) and the
+    jitted whole-step path — each compiles its own big composed
+    program, so they ride the unfiltered run (the nsteps=2 cross-
+    boundary pin and the eager within-step pin stay tier-1)."""
+    grid_shape = (16, 16, 16)
+    h, dx = 2, (0.3, 0.25, 0.2)
+    dt = 0.01
+    rng = np.random.default_rng(17)
+    state = {
+        "f": _arr(rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
+    }
+    args = {"a": 1.3, "hubble": 0.21}
+    sector = ps.ScalarSector(2, potential=_potential)
+    kw = dict(dtype=jnp.float64, **_XKW)
+    pair = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                              bx=4, by=8, **kw)
+    chunk = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                               chunk_stages=4, chunk_bx=4, chunk_by=8,
+                               **kw)
+    ref = pair.multi_step({k: _arr(np.asarray(v))
+                           for k, v in state.items()}, 3, 0.0, dt, args)
+    got = chunk.multi_step({k: _arr(np.asarray(v))
+                            for k, v in state.items()}, 3, 0.0, dt,
+                           args)
+    for name in ("f", "dfdt"):
+        assert np.array_equal(np.asarray(got[name]),
+                              np.asarray(ref[name]))
+    got1 = chunk.step({k: _arr(np.asarray(v))
+                       for k, v in state.items()}, 0.0, dt, args)
+    ref1 = pair.step({k: _arr(np.asarray(v))
+                      for k, v in state.items()}, 0.0, dt, args)
+    for name in ("f", "dfdt"):
+        assert np.array_equal(np.asarray(got1[name]),
+                              np.asarray(ref1[name]))
+
+
+def test_chunk_bf16_carry_matches_pair(decomp):
+    """Reduced-precision carries: the chunk body quantizes its composed
+    carry views at interior PAIR boundaries — exactly where the pair
+    sequence materializes (and rounds) them — so the CARRY outputs are
+    bit-identical. The f32 state outputs are pinned at one f32 ulp:
+    the mixed bf16/f32 convert+multiply chains give the backend
+    re-contraction freedom across the one-kernel-vs-two boundary (the
+    measured ~1-ulp effect doc/performance.md already records for
+    composed jits; the pure-f32/f64 chunk pin above stays exactly
+    bitwise)."""
+    grid_shape = (16, 16, 16)
+    h, dx = 2, (0.3, 0.25, 0.2)
+    dt = np.float32(0.01)
+    rng = np.random.default_rng(23)
+    state = {
+        "f": _arr(rng.standard_normal((2,) + grid_shape)
+                  .astype(np.float32)),
+        "dfdt": _arr(0.1 * rng.standard_normal((2,) + grid_shape)
+                     .astype(np.float32)),
+    }
+    args = {"a": np.float32(1.3), "hubble": np.float32(0.21)}
+    sector = ps.ScalarSector(2, potential=_potential)
+    kw = dict(dtype=jnp.float32, carry_dtype=jnp.bfloat16, **_XKW)
+    pair = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                              bx=4, by=8, **kw)
+    chunk = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                               chunk_stages=4, chunk_bx=4, chunk_by=8,
+                               **kw)
+    assert chunk._chunk_call is not None
+    # carry round trip at stage granularity: the quantization points
+    # coincide with the pair sequence's materializations, so the bf16
+    # CARRIES come out bit-identical
+    cp = pair.init_carry(state)
+    cp = pair.stage_pair(0, cp, 0.0, dt, args)
+    cp = pair.stage_pair(2, cp, 0.0, dt, args)
+    cc = chunk.init_carry(state)
+    cc = chunk.stage_chunk([0, 1, 2, 3], cc, 0.0, dt, [args] * 4)
+    for name in ("f", "dfdt"):
+        assert np.array_equal(np.asarray(cp[1][name]),
+                              np.asarray(cc[1][name])), \
+            f"k[{name}]: bf16 carry quantization diverges"
+        a = np.asarray(cp[0][name], np.float64)
+        b = np.asarray(cc[0][name], np.float64)
+        scale = np.max(np.abs(a)) or 1.0
+        assert np.max(np.abs(a - b)) / scale < 1e-6, \
+            f"{name}: bf16-carry chunk beyond the ulp bound"
+
+
+def test_chunk_fallback_ladder(decomp):
+    """Every degradation of the chunk tier is LOUD: bad depths raise,
+    sharded meshes / over-wide window halos warn and fall back to the
+    pair tier (kernel_fallback), and stage_chunk guards misuse."""
+    grid_shape = (16, 16, 16)
+    sector = ps.ScalarSector(2, potential=_potential)
+    kw = dict(dtype=jnp.float64, bx=4, by=8, **_XKW)
+
+    # odd / too-shallow depths are a usage error, not a fallback
+    with pytest.raises(ValueError, match="even number >= 4"):
+        FusedScalarStepper(sector, decomp, grid_shape, (0.3,) * 3, 2,
+                           chunk_stages=3, **kw)
+    with pytest.raises(ValueError, match="even number >= 4"):
+        FusedScalarStepper(sector, decomp, grid_shape, (0.3,) * 3, 2,
+                           chunk_stages=2, **kw)
+
+    # window halo beyond the 8-aligned y pad: ceil(10/2)*2 = 10 > 8
+    # (resident=False pins the streaming tier — on this tiny lattice
+    # the whole-lattice-resident kernel, whose rolls have no window to
+    # outgrow, would otherwise legitimately serve the deep chunk)
+    with pytest.warns(UserWarning, match="chunk fusion disabled"):
+        wide = FusedScalarStepper(sector, decomp, grid_shape,
+                                  (0.3,) * 3, 2, chunk_stages=10,
+                                  resident=False, **kw)
+    assert wide._chunk_call is None and wide._pair_call is not None
+
+    # stage_chunk without a chunk kernel
+    st = FusedScalarStepper(sector, decomp, grid_shape, (0.3,) * 3, 2,
+                            **kw)
+    with pytest.raises(RuntimeError, match="chunk fusion is not"):
+        st.stage_chunk([0, 1, 2, 3], st.init_carry(
+            {"f": _arr(np.zeros((2,) + grid_shape)),
+             "dfdt": _arr(np.zeros((2,) + grid_shape))}), 0.0, 0.01,
+            [{}] * 4)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+def test_chunk_sharded_falls_back_to_pair():
+    """Sharded meshes keep the pair tier (the chunk exchange would need
+    ceil(D/2)*h-wide halo slabs): the build warns, logs the fallback,
+    and the stepper still works via pair kernels."""
+    devs = (jax.devices("cpu") if _TPU_SESSION else jax.devices())[:2]
+    decomp = ps.DomainDecomposition((2, 1, 1), devices=devs)
+    sector = ps.ScalarSector(2, potential=_potential)
+    with pytest.warns(UserWarning, match="sharded mesh"):
+        st = FusedScalarStepper(sector, decomp, (16, 16, 16),
+                                (0.3,) * 3, 2, chunk_stages=4,
+                                dtype=jnp.float64, bx=4, by=8, **_XKW)
+    assert st._chunk_call is None and st._pair_call is not None
+    assert st.kernel_tier_report()["tier"] == "pair"
+
+
+@pytest.mark.slow
+def test_chunk_resident_matches_pair(decomp):
+    """The whole-lattice-resident tier's multi-stage variant: lattices
+    with no feasible streaming blocking (y % 8 != 0) chunk via
+    RollTaps composition. Pinned at one f64 ulp rather than bitwise:
+    the whole-lattice one-program body gives the backend FMA
+    re-contraction freedom vs the two-program pair sequence (the
+    measured ~1-ulp effect doc/performance.md records for composed
+    jits; the streaming chunk pin above is exactly bitwise). Slow: the
+    composed whole-lattice trace is the suite's biggest single
+    compile, and tier-1 already pins the shared composition logic
+    (streaming chunk) and the resident single/pair tiers."""
+    from pystella_tpu.ops.pallas_stencil import ResidentStencil
+
+    grid_shape = (12, 12, 12)
+    h, dx = 2, (0.3,) * 3
+    dt = 0.01
+    rng = np.random.default_rng(29)
+    state = {
+        "f": _arr(0.1 * rng.standard_normal((2,) + grid_shape)),
+        "dfdt": _arr(0.01 * rng.standard_normal((2,) + grid_shape)),
+    }
+    args = {"a": 1.1, "hubble": 0.1}
+    sector = ps.ScalarSector(2, potential=_potential)
+    kw = dict(dtype=jnp.float64, **_XKW)
+    pair = FusedScalarStepper(sector, decomp, grid_shape, dx, h, **kw)
+    chunk = FusedScalarStepper(sector, decomp, grid_shape, dx, h,
+                               chunk_stages=4, **kw)
+    assert isinstance(chunk._chunk_st, ResidentStencil)
+    assert chunk.kernel_tier_report()["tier"] == "resident-chunk"
+    ref = pair.multi_step({k: _arr(np.asarray(v))
+                           for k, v in state.items()}, 2, 0.0, dt, args)
+    got = chunk.multi_step({k: _arr(np.asarray(v))
+                            for k, v in state.items()}, 2, 0.0, dt,
+                           args)
+    for name in ("f", "dfdt"):
+        a, b = np.asarray(ref[name]), np.asarray(got[name])
+        scale = np.max(np.abs(a)) or 1.0
+        assert np.max(np.abs(a - b)) / scale < 1e-14, \
+            f"{name}: resident chunk diverges from pair sequence"
