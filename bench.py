@@ -948,6 +948,18 @@ def run_smoke(argv=None):
                         "bit-consistent resume; the report's `service` "
                         "section and the gate's SLO verdicts derive "
                         "from it")
+    p.add_argument("--no-fleet", action="store_true",
+                   help="skip the two-replica fleet drill: a pair of "
+                        "ScenarioService replicas announced into a "
+                        "throwaway replica registry, scraped and "
+                        "federated by obs.fleet.FleetAggregator (the "
+                        "seeded fleet burn alert fires AND resolves "
+                        "from replica-a's deadline story), with "
+                        "replica-b's live endpoint wedged and its "
+                        "heartbeats killed mid-run — the recorded "
+                        "fleet_replica_lost and the lossy scrape "
+                        "coverage feed the report's `fleet` section "
+                        "and the gate's honest-degraded annotation")
     p.add_argument("--no-autotune", action="store_true",
                    help="skip the fused-tier + autotune payload: a "
                         "tiny (bx, by, chunk-depth) sweep persisting "
@@ -1527,6 +1539,72 @@ def run_smoke(argv=None):
         except Exception as e:  # noqa: BLE001 — record, never kill smoke
             hb(f"smoke: service payload failed: "
                f"{type(e).__name__}: {e}")
+            traceback.print_exc()
+
+    # fleet drill: TWO ScenarioService replicas heartbeating into a
+    # throwaway replica registry, scraped over live HTTP and federated
+    # by obs.fleet.FleetAggregator. The orchestration is deterministic
+    # (blocking event-log subscribers, no sleeps-and-hope): replica-a's
+    # seeded deadline story replays through the fleet monitor so the
+    # fleet burn alert FIRES and RESOLVES inside the first scrape;
+    # replica-b's live endpoint is wedged (one recorded failed scrape
+    # against a still-beating record), then its heartbeats are killed —
+    # the aggregator records fleet_replica_lost (reason "expired") and
+    # the final scrape's lossy coverage is exactly what the report's
+    # `fleet` section carries and the gate annotates (honest-degraded)
+    # rather than refuses. The smoke e2e (tests/test_gate.py) pins the
+    # whole chain, including the exit-2 refusal of a synthetic report
+    # that claims complete coverage over this lossy record.
+    if not args.no_fleet:
+        try:
+            from pystella_tpu.service import loadgen as fleet_loadgen
+            fl_dir = os.path.join(args.out, "fleet_drill")
+            fleet_events = os.path.join(args.out, "fleet_events.jsonl")
+            # the drill replicas are a separate logical service: run
+            # them against their own event log so their service_*/slo_*
+            # records cannot contaminate the single-replica
+            # service/latency/alerts sections, then fold ONLY the
+            # fleet_* vocabulary back into the run record for the
+            # ledger's fleet section and the gate
+            obs.configure(fleet_events)
+            try:
+                fl = fleet_loadgen.run_fleet(fl_dir, label="smoke-fleet")
+            finally:
+                obs.configure(events_path)
+            with open(fleet_events) as src, open(events_path, "a") as dst:
+                for line in src:
+                    try:
+                        kind = json.loads(line).get("kind")
+                    except ValueError:
+                        continue
+                    if isinstance(kind, str) and kind.startswith("fleet_"):
+                        dst.write(line)
+            hb(f"smoke: fleet {len(fl['replicas'])} replica(s) "
+               f"({fl['scrapes']} scrape(s), "
+               f"{fl['endpoint_ok']} endpoint pass(es) / "
+               f"{fl['endpoint_failed']} failed, "
+               f"coverage {fl['scrape_success_rate']:.0%}), "
+               f"killed {fl['killed']} -> "
+               f"{fl['lost'][0]['reason'] if fl['lost'] else '?'}, "
+               f"{fl['alerts']} fleet alert(s) fired / "
+               f"{fl['resolved']} resolved"
+               + (f", still burning: {fl['alerting']}"
+                  if fl.get("alerting") else ""))
+            lost_reasons = [e.get("reason") for e in fl["lost"]]
+            if not (fl["live_both_pass"] >= 2
+                    and len(fl["queue_gauge_replicas"]) == 2
+                    and fl["alerts"] >= 2 and fl["resolved"] >= 1
+                    and "dead_replicas" in fl["alerting"]
+                    and fl["dead"] == 1
+                    and lost_reasons == ["expired"]):
+                obs.emit("smoke_fleet_failed",
+                         live_both_pass=fl["live_both_pass"],
+                         queue_gauge_replicas=fl["queue_gauge_replicas"],
+                         alerts=fl["alerts"], resolved=fl["resolved"],
+                         alerting=fl["alerting"], dead=fl["dead"],
+                         lost_reasons=lost_reasons)
+        except Exception as e:  # noqa: BLE001 — record, never kill smoke
+            hb(f"smoke: fleet drill failed: {type(e).__name__}: {e}")
             traceback.print_exc()
 
     # AOT warm-start leg: export the very step program this run timed,

@@ -478,7 +478,12 @@ def worker_service(dry_run):
     plane rides the same leg: ``PYSTELLA_LIVE_PORT`` is armed, a
     scraper thread polls ``/metrics`` and ``/slo`` MID-loadgen, and the
     last successful scrape lands in the leg record — the first
-    hardware window then also validates the live plane."""
+    hardware window then also validates the live plane. A fleet
+    federation sub-leg follows: the two-replica drill
+    (``loadgen.run_fleet``) runs on the held device, its federated
+    stats and the ledger's ``fleet`` section land in the leg record,
+    and the leg fails unless both replicas federated live, the seeded
+    fleet alert fired, and the staged crash was declared lost."""
     import threading
 
     backend, ndev, dial_s = _dial(dry_run)
@@ -557,6 +562,37 @@ def worker_service(dry_run):
         stop_scraper.set()
         scraper_thread.join(timeout=5)
     wall_s = time.perf_counter() - t0
+
+    # fleet federation sub-leg: the deterministic two-replica drill on
+    # the held device — both replicas announce into a throwaway
+    # registry, the aggregator federates their live endpoints, and the
+    # wedge+crash loss record is captured on hardware. The drill
+    # replicas are a separate logical service, so they run against
+    # their own event log and only the fleet_* vocabulary folds back
+    # into the window record (the ledger's service/latency sections
+    # are label-blind and must stay single-replica).
+    fleet_events = os.path.join(OUT, "tpu_window_fleet_events.jsonl")
+    fl = None
+    try:
+        obs.configure(fleet_events)
+        try:
+            fl = loadgen.run_fleet(
+                os.path.join(OUT, "tpu_window_fleet"),
+                label="window-fleet")
+        finally:
+            obs.configure(events_path)
+        with open(fleet_events) as src, open(events_path, "a") as dst:
+            for ln in src:
+                try:
+                    kind = json.loads(ln).get("kind")
+                except ValueError:
+                    continue
+                if isinstance(kind, str) and kind.startswith("fleet_"):
+                    dst.write(ln)
+    except Exception:  # noqa: BLE001 — recorded below as fl=None
+        import traceback
+        traceback.print_exc()
+
     led = PerfLedger.from_events(events_path,
                                  label=f"service-{grid}^3")
     sv = led.service() or {}
@@ -594,6 +630,16 @@ def worker_service(dry_run):
            slo=stats.get("slo"),
            live_port=live_port,
            live_scrape=scrape or None,
+           fleet=(None if fl is None else dict(
+               replicas=len(fl["replicas"]),
+               scrapes=fl["scrapes"],
+               endpoint_ok=fl["endpoint_ok"],
+               endpoint_failed=fl["endpoint_failed"],
+               coverage=fl["scrape_success_rate"],
+               alerts=fl["alerts"], resolved=fl["resolved"],
+               alerting=fl["alerting"], dead=fl["dead"],
+               lost=[e.get("reason") for e in fl["lost"]],
+               fleet_section=bool(led.fleet()))),
            alerts=led.alerts())
     ok = (stats.get("preempt_bitexact") is True
           and stats.get("lease_failures") == 0
@@ -603,7 +649,13 @@ def worker_service(dry_run):
           # fired and resolved in the same record
           and bool(scrape.get("scrapes"))
           and (stats.get("slo") or {}).get("alerts", 0) >= 1
-          and not (stats.get("slo") or {}).get("alerting"))
+          and not (stats.get("slo") or {}).get("alerting")
+          # the fleet half: both replicas federated live, the seeded
+          # fleet alert fired, and the staged crash was declared lost
+          and fl is not None
+          and fl.get("live_both_pass", 0) >= 2
+          and fl.get("alerts", 0) >= 2
+          and fl.get("dead") == 1)
     return 0 if ok else 1
 
 

@@ -269,6 +269,32 @@ register("PYSTELLA_SLO_MIN_SAMPLES", default="1", kind="int",
               "exempt — their value IS the sample count); raise it on "
               "a busy service so a single outlier dispatch cannot "
               "page")
+register("PYSTELLA_FLEET_DIR", default=None, kind="path",
+         help="shared replica-registry directory of the fleet "
+              "observability plane (service.registry / obs.fleet): "
+              "when set, ScenarioService.serve() announces a "
+              "heartbeated JSON record there (replica id, live URL, "
+              "stack fingerprint, warm-pool fingerprints, queue "
+              "depth) and withdraws it on exit; unset (default) "
+              "disables the fleet plane entirely")
+register("PYSTELLA_FLEET_HEARTBEAT_S", default="2.0", kind="float",
+         help="cadence in seconds at which a fleet replica rewrites "
+              "its registry record (service.registry.ReplicaRegistry); "
+              "each beat refreshes the dynamic fields (queue depth, "
+              "serving state, warm fingerprints); <= 0 announces once "
+              "and never beats (tests)")
+register("PYSTELLA_FLEET_EXPIRE_S", default="10", kind="float",
+         help="heartbeat age in seconds past which registry readers "
+              "(obs.fleet.FleetAggregator, service status --fleet) "
+              "treat a replica record as stale/dead — a crashed "
+              "replica cannot tombstone itself, so expiry is how the "
+              "fleet notices; keep it several heartbeats wide")
+register("PYSTELLA_FLEET_SCRAPE_TIMEOUT_S", default="2.0", kind="float",
+         help="per-endpoint HTTP timeout in seconds for one fleet "
+              "scrape of a replica's /metrics, /slo, /healthz "
+              "(obs.fleet.FleetAggregator); a replica slower than "
+              "this counts as a scrape failure, not a hang of the "
+              "whole aggregation pass")
 register("PYSTELLA_TRACE_SERVICE", default="1", kind="bool",
          help="request-scoped distributed tracing in the scenario "
               "service: 1 (default) allocates a trace id per "

@@ -268,6 +268,23 @@ for _name, _help in (
     ("obs_subscriber_error", "an EventLog emit subscriber raised; the "
                              "emit path degraded it to this one-time "
                              "event instead of breaking"),
+    # -- fleet observability plane (service.registry / obs.fleet) -----------
+    ("fleet_announce", "a serving replica published its registry record "
+                       "(replica id, url, stack fingerprint)"),
+    ("fleet_withdraw", "a replica withdrew its registry record cleanly "
+                       "(tombstone written, heartbeats stopped)"),
+    ("fleet_scrape", "one fleet aggregation pass: per-replica scrape "
+                     "outcomes, merged fleet SLO legs, skew/divergence"),
+    ("fleet_replica_lost", "a previously-live replica went dark without "
+                           "withdrawing (heartbeat expired or endpoint "
+                           "unreachable)"),
+    ("fleet_alert", "a fleet-level SLO burn-rate alert FIRED "
+                    "(obs.fleet.FleetAggregator; leg, value, bar)"),
+    ("fleet_resolved", "a burning fleet SLO leg recovered below its "
+                       "bar (duration_s since the matching "
+                       "fleet_alert)"),
+    ("fleet_loadgen", "the two-replica fleet drill summary "
+                      "(service.loadgen.run_fleet)"),
     # -- driver-side kinds (bench.py / examples; outside the package, so
     # -- not lint-audited, but registered so the vocabulary is one list)
     ("bench_run", "bench payload run metadata"),
@@ -284,6 +301,7 @@ for _name, _help in (
                               "failed its pins"),
     ("smoke_remesh_failed", "smoke: remesh drill failed"),
     ("smoke_service_failed", "smoke: service payload failed"),
+    ("smoke_fleet_failed", "smoke: two-replica fleet drill failed"),
 ):
     register_event_kind(_name, _help)
 del _name, _help

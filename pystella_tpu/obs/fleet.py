@@ -1,0 +1,578 @@
+"""Fleet federation: N replicas' live endpoints, one fleet view.
+
+The live plane (:mod:`pystella_tpu.obs.live`) gives every replica its
+own ``/metrics`` / ``/healthz`` / ``/slo``; the replica registry
+(:mod:`pystella_tpu.service.registry`) answers who is in the fleet.
+This module closes the loop: :class:`FleetAggregator` reads the
+registry, scrapes every live replica's three endpoints, and federates
+them into one fleet-level view with the same evidence discipline as
+every single-replica subsystem — registered events in, ledger section
+and gate verdicts out.
+
+**Merging.** ``/metrics`` is parsed by :func:`parse_prometheus` — we
+round-trip our own Prometheus 0.0.4 exposition, the same text a real
+collector would scrape, so the federation path exercises the format
+end to end. Counters merge by sum (fleet totals); gauges stay
+per-replica-labeled (a fleet-mean queue depth is a lie when one
+replica is drowning). The ``pystella_build_info`` gauge's labels are
+the scrape-side half of skew detection.
+
+**Fleet SLOs.** Each replica's ``/slo`` exposes its legs' recent
+``samples``; the aggregator replays every not-yet-ingested sample
+(deduplicated per replica+leg by timestamp) into its own
+:class:`~pystella_tpu.obs.slo.SLOMonitor` via
+:meth:`~pystella_tpu.obs.slo.SLOMonitor.add_sample` — so the fleet
+queue-p95 is a true p95 over BOTH replicas' dispatch samples, and
+fleet alerts fire/resolve under the identical fast/slow multi-window
+burn rule. One extra leg exists only at fleet level:
+``dead_replicas`` (bar 0 — any replica lost without a withdraw
+burns until the record is acknowledged or recovered).
+
+**Loss.** A replica that tombstoned (``withdrawn``) left cleanly. A
+replica whose heartbeat expired, or whose endpoint fails several
+consecutive scrapes while its record still beats, is LOST:
+``fleet_replica_lost`` is emitted once and the replica counts into
+``dead_replicas`` until it returns. The ledger's ``fleet`` section
+and the gate's fleet verdicts are built from these events — a report
+claiming full-fleet coverage over a scrape record with losses is
+refused as invalid evidence.
+
+Ops CLI::
+
+    python -m pystella_tpu.obs.fleet status          # one pass
+    python -m pystella_tpu.obs.fleet watch -i 2      # live table
+
+Both read ``PYSTELLA_FLEET_DIR`` (or ``--dir``) and need nothing but
+a filesystem view of the registry plus loopback HTTP to the replicas.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import slo as _slo
+from pystella_tpu.service import registry as _registry
+
+__all__ = ["DEFAULT_FLEET_LEGS", "FleetAggregator", "parse_prometheus"]
+
+#: fleet-level SLO legs: the replica legs re-evaluated over the merged
+#: sample stream, plus ``dead_replicas`` (fleet-only; any lost replica
+#: breaches its zero bar). Same spec schema as
+#: :data:`pystella_tpu.obs.slo.DEFAULT_LEGS`.
+DEFAULT_FLEET_LEGS = {
+    "queue_p95": {"objective": 0.0, "factor": 2.5, "floor": 0.5,
+                  "kind": "p95"},
+    "warm_ttfs": {"objective": 0.0, "factor": 2.5, "floor": 1.0,
+                  "kind": "p50"},
+    "deadline_miss": {"objective": 0.0, "factor": 2.0, "floor": 0.05,
+                      "kind": "rate"},
+    "incident_rate": {"objective": 0.0, "factor": 1.0, "floor": 0.0,
+                      "kind": "count"},
+    "dead_replicas": {"objective": 0.0, "factor": 1.0, "floor": 0.0,
+                      "kind": "rate"},
+}
+
+#: consecutive endpoint-scrape failures after which a replica whose
+#: registry record still beats is declared lost anyway (a wedged
+#: process can keep heartbeating while its server thread is dead)
+_UNREACHABLE_AFTER = 3
+
+
+# -- the exposition parser ---------------------------------------------------
+
+
+def _unescape_label(raw):
+    out, i = [], 0
+    while i < len(raw):
+        c = raw[i]
+        if c == "\\" and i + 1 < len(raw):
+            nxt = raw[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(body):
+    """``k1="v1",k2="v2"`` -> dict, honouring the text format's
+    escapes (``\\\\``, ``\\"``, ``\\n``) — the inverse of
+    ``live._prom_label``."""
+    labels, i, n = {}, 0, len(body)
+    while i < n:
+        while i < n and body[i] in ", ":
+            i += 1
+        if i >= n:
+            break
+        eq = body.index("=", i)
+        name = body[i:eq].strip()
+        if body[eq + 1] != '"':
+            raise ValueError(f"malformed label at {body[i:]!r}")
+        j = eq + 2
+        buf = []
+        while j < n:
+            c = body[j]
+            if c == "\\" and j + 1 < n:
+                buf.append(body[j:j + 2])
+                j += 2
+            elif c == '"':
+                break
+            else:
+                buf.append(c)
+                j += 1
+        labels[name] = _unescape_label("".join(buf))
+        i = j + 1
+    return labels
+
+
+def parse_prometheus(text):
+    """Parse a Prometheus text-format (0.0.4) exposition into
+    ``{name: {"type": kind, "help": str|None,
+    "samples": [(labels_dict, value), ...]}}`` — the inverse of
+    :func:`pystella_tpu.obs.live.render_prometheus`, so the fleet
+    aggregator consumes exactly what a real collector would. Unknown
+    or malformed lines are skipped (a federation pass must not die on
+    one bad line); untyped samples get type ``"untyped"``."""
+    families = {}
+
+    def family(name):
+        return families.setdefault(
+            name, {"type": "untyped", "help": None, "samples": []})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("TYPE", "HELP"):
+                fam = family(parts[2])
+                if parts[1] == "TYPE":
+                    fam["type"] = parts[3] if len(parts) > 3 else "untyped"
+                else:
+                    fam["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                body, value_part = rest.rsplit("}", 1)
+                labels = _parse_labels(body)
+            else:
+                name, value_part = line.split(None, 1)
+                labels = {}
+            value = float(value_part.strip().split()[0])
+        except (ValueError, IndexError):
+            continue
+        family(name.strip())["samples"].append((labels, value))
+    return families
+
+
+# -- the aggregator ----------------------------------------------------------
+
+
+class FleetAggregator:
+    """Registry-driven fleet scraper + federator (module docstring).
+
+    :arg registry_dir: the shared registry directory; ``None`` reads
+        the registered ``PYSTELLA_FLEET_DIR`` (raises ``ValueError``
+        when that is unset too — an aggregator without a registry has
+        nothing to aggregate).
+    :arg expire_s / timeout_s: heartbeat expiry and per-endpoint HTTP
+        timeout; default to the registered ``PYSTELLA_FLEET_*`` knobs.
+    :arg legs: fleet SLO leg overrides, merged over
+        :data:`DEFAULT_FLEET_LEGS` exactly like
+        :class:`~pystella_tpu.obs.slo.SLOMonitor` merges its own.
+    :arg label: tag on every emitted fleet event.
+    :arg emit: emit ``fleet_*`` events (default; ``False`` keeps the
+        aggregator silent for synthetic-replica unit tests).
+    """
+
+    def __init__(self, registry_dir=None, expire_s=None, timeout_s=None,
+                 legs=None, fast_window_s=None, slow_window_s=None,
+                 min_samples=None, label="fleet", emit=True):
+        if registry_dir is None:
+            registry_dir = _config.getenv("PYSTELLA_FLEET_DIR")
+        if not registry_dir:
+            raise ValueError(
+                "no registry directory: pass registry_dir or set "
+                "PYSTELLA_FLEET_DIR")
+        self.registry_dir = str(registry_dir)
+        if expire_s is None:
+            expire_s = _config.get_float("PYSTELLA_FLEET_EXPIRE_S")
+        if timeout_s is None:
+            timeout_s = _config.get_float("PYSTELLA_FLEET_SCRAPE_TIMEOUT_S")
+        self.expire_s = float(expire_s)
+        self.timeout_s = float(timeout_s)
+        self.label = str(label)
+        self.emit_events = bool(emit)
+        chosen = (dict(DEFAULT_FLEET_LEGS) if legs is None
+                  else {name: {**DEFAULT_FLEET_LEGS.get(name, {}),
+                               **(spec or {})}
+                        for name, spec in legs.items()})
+        # the monitor stays silent: the aggregator owns the fleet_*
+        # event vocabulary and emits transitions itself
+        self.monitor = _slo.SLOMonitor(
+            legs=chosen, fast_window_s=fast_window_s,
+            slow_window_s=slow_window_s, min_samples=min_samples,
+            label=f"{self.label}-slo", emit=False)
+        self._fleet_legs = set(chosen)
+        self.replicas = {}          # id -> bookkeeping dict
+        self.scrapes = 0            # aggregation passes
+        self.endpoint_ok = 0        # per-replica scrape outcomes
+        self.endpoint_failed = 0
+        self.lost = []              # [{replica, ts, reason}]
+        self.alert_log = []         # [{leg, change, ts, ...}]
+        self.counters = {}          # fleet-summed counters, last pass
+        self.gauges = {}            # name -> {replica: value}, last pass
+        self.skew = {"skewed": False, "fingerprints": {}}
+        self.divergence = {"divergent": {}, "signatures": 0}
+        self._seen = {}             # (replica, leg) -> last ingested ts
+
+    # -- one replica ---------------------------------------------------------
+
+    def _get_json(self, url):
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    def _scrape_replica(self, record):
+        url = record.get("url")
+        if not url:
+            return {"error": "no url in registry record"}
+        base = url.rstrip("/")
+        try:
+            with urllib.request.urlopen(
+                    base + "/metrics", timeout=self.timeout_s) as r:
+                metrics_text = r.read().decode()
+            return {
+                "metrics": parse_prometheus(metrics_text),
+                "slo": self._get_json(base + "/slo"),
+                "healthz": self._get_json(base + "/healthz"),
+                "error": None,
+            }
+        except (OSError, ValueError, urllib.error.URLError) as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _merge_metrics(self, rid, families, counters, gauges):
+        for name, fam in families.items():
+            kind = fam["type"]
+            if kind == "counter":
+                total = sum(v for _labels, v in fam["samples"])
+                counters[name] = counters.get(name, 0.0) + total
+            elif kind == "gauge":
+                # keep gauges per-replica: only the unlabeled headline
+                # sample (labeled series stay replica-local detail)
+                for labels, v in fam["samples"]:
+                    if not labels:
+                        gauges.setdefault(name, {})[rid] = v
+        info = families.get("pystella_build_info")
+        if info and info["samples"]:
+            return dict(info["samples"][0][0])
+        return None
+
+    def _ingest_slo(self, rid, payload, transitions):
+        legs = (payload or {}).get("legs") or {}
+        for leg_name, leg_state in legs.items():
+            if leg_name not in self._fleet_legs:
+                continue
+            key = (rid, leg_name)
+            last = self._seen.get(key)
+            for ts, value in (leg_state.get("samples") or []):
+                if last is not None and ts <= last:
+                    continue
+                transitions.extend(
+                    self.monitor.add_sample(leg_name, value, ts=ts))
+                last = ts
+            if last is not None:
+                self._seen[key] = last
+
+    # -- the aggregation pass ------------------------------------------------
+
+    def scrape(self, now=None):
+        """One full pass: read the registry, scrape every live
+        replica, merge, re-evaluate the fleet SLOs, detect skew and
+        divergence, emit ``fleet_*`` events. Returns :meth:`state`."""
+        now = time.time() if now is None else float(now)
+        self.scrapes += 1
+        records = _registry.read_records(
+            self.registry_dir, expire_s=self.expire_s, now=now)
+        by_id = {r["replica"]: r for r in records}
+        counters, gauges = {}, {}
+        transitions = []
+        pass_ok = pass_failed = 0
+        for rec in records:
+            rid = rec["replica"]
+            book = self.replicas.setdefault(rid, {
+                "replica": rid, "ever_live": False, "lost": False,
+                "withdrawn": False, "consecutive_failures": 0,
+                "scrapes_ok": 0, "scrapes_failed": 0,
+                "build_info": None, "healthz": None})
+            book["record"] = rec
+            book["withdrawn"] = rec["status"] == "withdrawn"
+            if rec["status"] != "live":
+                continue
+            book["ever_live"] = True
+            result = self._scrape_replica(rec)
+            if result.get("error"):
+                pass_failed += 1
+                book["scrapes_failed"] += 1
+                book["consecutive_failures"] += 1
+                book["last_error"] = result["error"]
+                continue
+            pass_ok += 1
+            book["scrapes_ok"] += 1
+            book["consecutive_failures"] = 0
+            book["healthz"] = result["healthz"]
+            book["build_info"] = self._merge_metrics(
+                rid, result["metrics"], counters, gauges)
+            self._ingest_slo(rid, result["slo"], transitions)
+            if book["lost"]:
+                book["lost"] = False  # it came back
+        self.endpoint_ok += pass_ok
+        self.endpoint_failed += pass_failed
+        self.counters, self.gauges = counters, gauges
+
+        # -- loss: expired heartbeat, or live-but-unreachable ----------------
+        for rid, book in self.replicas.items():
+            rec = by_id.get(rid)
+            status = rec["status"] if rec else "stale"
+            if not book["ever_live"] or book["withdrawn"] or book["lost"]:
+                continue
+            reason = None
+            if status == "stale":
+                reason = "expired"
+            elif (status == "live"
+                  and book["consecutive_failures"] >= _UNREACHABLE_AFTER):
+                reason = "unreachable"
+            if reason:
+                book["lost"] = True
+                entry = {"replica": rid, "ts": now, "reason": reason,
+                         "age_s": rec.get("age_s") if rec else None}
+                self.lost.append(entry)
+                if self.emit_events:
+                    _events.emit("fleet_replica_lost", label=self.label,
+                                 **entry)
+        dead = sum(1 for b in self.replicas.values() if b["lost"])
+        transitions.extend(
+            self.monitor.add_sample("dead_replicas", float(dead), ts=now,
+                                    evaluate=False) or [])
+        transitions.extend(self.monitor.evaluate(now=now))
+        self._note_transitions(transitions, now)
+
+        # -- skew + divergence across live records ---------------------------
+        live = [r for r in records if r["status"] == "live"]
+        fps = {}
+        for rec in live:
+            fp = rec.get("fingerprint") or "unknown"
+            info = (self.replicas[rec["replica"]].get("build_info")
+                    or {})
+            key = (fp, info.get("flags_fingerprint"),
+                   info.get("jax"), info.get("device_kind"))
+            fps.setdefault("|".join(str(k) for k in key),
+                           []).append(rec["replica"])
+        self.skew = {"skewed": len(fps) > 1, "fingerprints": fps}
+        sigs = {}
+        for rec in live:
+            for sig, fp in (rec.get("warm_fingerprints") or {}).items():
+                sigs.setdefault(sig, {}).setdefault(
+                    str(fp), []).append(rec["replica"])
+        self.divergence = {
+            "signatures": len(sigs),
+            "divergent": {sig: fps_ for sig, fps_ in sigs.items()
+                          if len(fps_) > 1},
+        }
+
+        state = self.state(now=now)
+        if self.emit_events:
+            _events.emit(
+                "fleet_scrape", label=self.label,
+                replicas=[{
+                    "replica": r["replica"], "status": r["status"],
+                    "url": r.get("url"),
+                    "age_s": (round(r["age_s"], 3)
+                              if isinstance(r.get("age_s"), float)
+                              else r.get("age_s")),
+                    "fingerprint": r.get("fingerprint"),
+                    "queue_depth": r.get("queue_depth"),
+                } for r in records],
+                ok=pass_ok, failed=pass_failed, dead=dead,
+                legs={name: {"value_fast": leg.get("value_fast"),
+                             "bar": leg.get("bar"),
+                             "alerting": leg.get("alerting")}
+                      for name, leg in state["legs"].items()},
+                skewed=self.skew["skewed"],
+                stacks=len(self.skew["fingerprints"]),
+                divergent=sorted(self.divergence["divergent"]))
+        return state
+
+    def _note_transitions(self, transitions, now):
+        if not transitions:
+            return
+        legs = self.monitor.state()["legs"]
+        for name, change in transitions:
+            leg = legs.get(name, {})
+            entry = {"leg": name, "change": change, "ts": now,
+                     "value": leg.get("value_fast"),
+                     "bar": leg.get("bar")}
+            self.alert_log.append(entry)
+            if not self.emit_events:
+                continue
+            if change == "fired":
+                _events.emit("fleet_alert", leg=name,
+                             value=leg.get("value_fast"),
+                             bar=leg.get("bar"),
+                             burn_fast=leg.get("burn_fast"),
+                             burn_slow=leg.get("burn_slow"),
+                             label=self.label)
+            else:
+                _events.emit("fleet_resolved", leg=name,
+                             value=leg.get("value_fast"),
+                             bar=leg.get("bar"),
+                             duration_s=round(
+                                 leg.get("duration_s") or 0.0, 6),
+                             label=self.label)
+
+    # -- introspection -------------------------------------------------------
+
+    def state(self, now=None):
+        """The JSON-safe fleet view: per-replica rows, merged
+        counters/gauges, fleet SLO legs, loss + skew + divergence
+        records, scrape bookkeeping."""
+        now = time.time() if now is None else float(now)
+        rows = {}
+        for rid, book in sorted(self.replicas.items()):
+            rec = book.get("record") or {}
+            rows[rid] = {
+                "status": ("lost" if book["lost"]
+                           else rec.get("status", "unknown")),
+                "url": rec.get("url"),
+                "age_s": rec.get("age_s"),
+                "fingerprint": rec.get("fingerprint"),
+                "device_kind": rec.get("device_kind"),
+                "queue_depth": rec.get("queue_depth"),
+                "serving": rec.get("serving"),
+                "scrapes_ok": book["scrapes_ok"],
+                "scrapes_failed": book["scrapes_failed"],
+                "build_info": book.get("build_info"),
+            }
+        attempts = self.endpoint_ok + self.endpoint_failed
+        mstate = self.monitor.state()
+        return {
+            "label": self.label,
+            "registry_dir": self.registry_dir,
+            "ts": now,
+            "replicas": rows,
+            "live": sum(1 for r in rows.values()
+                        if r["status"] == "live"),
+            "lost": list(self.lost),
+            "dead": sum(1 for b in self.replicas.values()
+                        if b["lost"]),
+            "scrapes": self.scrapes,
+            "endpoint_ok": self.endpoint_ok,
+            "endpoint_failed": self.endpoint_failed,
+            "scrape_success_rate": (self.endpoint_ok / attempts
+                                    if attempts else None),
+            "counters": dict(self.counters),
+            "gauges": {k: dict(v) for k, v in self.gauges.items()},
+            "legs": mstate["legs"],
+            "alerting": mstate["alerting"],
+            "alerts_total": mstate["alerts_total"],
+            "resolved_total": mstate["resolved_total"],
+            "flaps_total": mstate["flaps_total"],
+            "alert_log": list(self.alert_log),
+            "skew": dict(self.skew),
+            "divergence": dict(self.divergence),
+        }
+
+
+# -- ops CLI -----------------------------------------------------------------
+
+
+def _render(state):
+    lines = []
+    lines.append(f"fleet @ {state['registry_dir']}  "
+                 f"(pass {state['scrapes']}, "
+                 f"live {state['live']}, dead {state['dead']})")
+    lines.append(f"{'replica':<20} {'status':<10} {'age_s':>7} "
+                 f"{'queue':>5} {'ok/fail':>8} {'fingerprint':<14} url")
+    for rid, row in state["replicas"].items():
+        age = row.get("age_s")
+        age_s = f"{age:.2f}" if isinstance(age, (int, float)) else "—"
+        q = row.get("queue_depth")
+        okf = f"{row['scrapes_ok']}/{row['scrapes_failed']}"
+        lines.append(
+            f"{rid:<20} {row['status']:<10} {age_s:>7} "
+            f"{q if q is not None else '—':>5} {okf:>8} "
+            f"{(row.get('fingerprint') or '—'):<14} "
+            f"{row.get('url') or '—'}")
+    legs = state["legs"]
+    if legs:
+        lines.append("fleet SLO legs:")
+        for name, leg in sorted(legs.items()):
+            v = leg.get("value_fast")
+            v_s = "—" if v is None else f"{v:.4g}"
+            mark = " ALERTING" if leg.get("alerting") else ""
+            lines.append(f"  {name:<16} value {v_s:>8}  "
+                         f"bar {leg['bar']:.4g}{mark}")
+    if state["skew"].get("skewed"):
+        lines.append(f"SKEW: {len(state['skew']['fingerprints'])} "
+                     "distinct stacks across live replicas")
+    if state["divergence"].get("divergent"):
+        lines.append("WARM DIVERGENCE: "
+                     + ", ".join(sorted(state["divergence"]["divergent"])))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m pystella_tpu.obs.fleet",
+        description="fleet ops view: scrape the replica registry and "
+                    "every live replica's /metrics //slo //healthz")
+    parser.add_argument("command", choices=("status", "watch"),
+                        help="status: one aggregation pass; watch: "
+                             "repeat every --interval seconds")
+    parser.add_argument("--dir", default=None,
+                        help="registry dir (default PYSTELLA_FLEET_DIR)")
+    parser.add_argument("--expire", type=float, default=None,
+                        help="heartbeat expiry override (s)")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="per-endpoint scrape timeout override (s)")
+    parser.add_argument("--interval", "-i", type=float, default=2.0,
+                        help="watch cadence (s)")
+    parser.add_argument("--count", type=int, default=0,
+                        help="watch: stop after N passes (0 = forever)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw state dict instead of the "
+                             "table")
+    args = parser.parse_args(argv)
+    try:
+        agg = FleetAggregator(registry_dir=args.dir,
+                              expire_s=args.expire,
+                              timeout_s=args.timeout, emit=False)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    passes = 0
+    while True:
+        state = agg.scrape()
+        if args.json:
+            print(json.dumps(state, sort_keys=True, default=str))
+        else:
+            print(_render(state))
+        passes += 1
+        if args.command == "status" or (args.count
+                                        and passes >= args.count):
+            break
+        time.sleep(args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

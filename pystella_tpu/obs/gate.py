@@ -27,7 +27,10 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       baseline's by both ``latency_miss_factor`` and
       ``latency_miss_floor`` (``--no-latency`` opts out; traced
       requests whose span tree fails to assemble degrade to a
-      coverage-loss warning)
+      coverage-loss warning) — or a FLEET SLO regression: the
+      ``fleet`` section's aggregated queue-p95 or warm-TTFS exceeds
+      the baseline's by both the configured factor and floor
+      (``--no-fleet`` opts out)
 2     invalid evidence: the contamination detector flagged the run
       (outlier burst / bimodal step times — the round-5 concurrent-probe
       signature), the report has no step samples, the run DIVERGED (a
@@ -42,7 +45,11 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       live burn alert UNRESOLVED at exit while the matching post-hoc
       SLO section claims green (the live and post-hoc halves
       contradict; ``--no-alerts`` opts out, alert-FLAP growth merely
-      warns), or baseline and current were measured on
+      warns), the report's ``fleet`` section claims COMPLETE fleet
+      coverage while its own scrape record shows lost replicas or
+      failed scrapes (fleet aggregates over the survivors are partial
+      evidence; an HONESTLY-partial fleet record is annotated
+      degraded instead), or baseline and current were measured on
       different hardware. Exception: a
       run that recorded AND recovered REAL (non-harness-injected)
       incidents (``resilience`` section,
@@ -227,7 +234,10 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     service_ttfs_factor=2.5,
                     service_ttfs_floor_s=1.0,
                     check_latency=True, latency_miss_factor=2.0,
-                    latency_miss_floor=0.05, check_alerts=True):
+                    latency_miss_floor=0.05, check_alerts=True,
+                    check_fleet=True, fleet_queue_factor=2.5,
+                    fleet_queue_floor_s=0.5, fleet_ttfs_factor=2.5,
+                    fleet_ttfs_floor_s=1.0):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -304,6 +314,20 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     survivors; the ledger produces it automatically from the
     ``remesh_plan`` record) — and a run that finished degraded
     without any ``remesh_plan`` record warns (unauditable).
+
+    ``check_fleet`` (default on): the federation half of the same
+    honesty rule, for reports carrying a ``fleet`` section
+    (:mod:`pystella_tpu.obs.fleet`). A report whose fleet coverage
+    block claims ``complete`` while its own scrape record shows lost
+    replicas or failed scrapes is refused (exit 2) — fleet aggregates
+    over the survivors are partial evidence. The honest version of the
+    same record (coverage says partial) is annotated
+    (``verdict["degraded"]`` + warning), never silently accepted.
+    Against a baseline, fleet queue-p95 and fleet warm-TTFS regress
+    under the same factor+floor bars as the single-replica service
+    legs (exit 1); version/flag skew appearing, warm-fingerprint
+    divergence, and fleet-alert flap growth warn. ``--no-fleet`` opts
+    out.
     """
     verdict = {"ok": True, "exit_code": 0, "reasons": [],
                "warnings": []}
@@ -484,6 +508,41 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                 "compile(s) recorded inside warm leases — the warm "
                 "path is supposed to be pure dispatch; check the "
                 "service section's lease records")
+
+    if check_fleet:
+        cfl = current.get("fleet") or {}
+        cov = cfl.get("coverage") or {}
+        lossy = bool((cfl.get("replicas_lost") or [])
+                     or (cov.get("endpoint_failed") or 0) > 0)
+        if cfl and cov.get("complete") and lossy:
+            # the report CLAIMS its fleet numbers cover the whole
+            # fleet while its own scrape record shows replicas lost or
+            # scrapes failed: whatever the aggregated legs measured,
+            # it was the survivors — a full-fleet throughput/SLO claim
+            # over partial evidence proves nothing either way
+            verdict.update(ok=False, exit_code=2)
+            verdict["reasons"].append(
+                "invalid_evidence: report claims complete fleet "
+                "coverage but its scrape record shows "
+                f"{len(cfl.get('replicas_lost') or [])} lost "
+                f"replica(s) and {cov.get('endpoint_failed') or 0} "
+                "failed scrape(s) — fleet aggregates over the "
+                "survivors are partial evidence, not a fleet claim")
+            return verdict
+        if cfl and lossy:
+            # the honest version of the same record: the report SAYS
+            # its coverage is partial — degraded evidence, annotated
+            # like a recovered incident, never silently accepted
+            verdict["degraded"] = True
+            lost_ids = sorted({str(r.get("replica"))
+                               for r in cfl.get("replicas_lost") or []})
+            verdict["warnings"].append(
+                "fleet: degraded fleet evidence — "
+                f"{len(lost_ids)} replica(s) lost mid-run "
+                f"({', '.join(lost_ids) or '?'}), scrape success "
+                f"{cfl.get('scrape_success_rate')} — fleet legs "
+                "aggregate the survivors; see the report's fleet "
+                "section before trusting fleet-wide claims")
 
     if check_latency:
         clat = current.get("latency") or {}
@@ -697,6 +756,12 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
         _compare_latency(verdict, baseline, current,
                          miss_factor=latency_miss_factor,
                          miss_floor=latency_miss_floor)
+    if check_fleet:
+        _compare_fleet(verdict, baseline, current,
+                       queue_factor=fleet_queue_factor,
+                       queue_floor_s=fleet_queue_floor_s,
+                       ttfs_factor=fleet_ttfs_factor,
+                       ttfs_floor_s=fleet_ttfs_floor_s)
     if check_resilience and (baseline or {}).get("resilience") \
             and not current.get("resilience"):
         verdict["warnings"].append(
@@ -906,6 +971,90 @@ def _compare_service(verdict, baseline, current, queue_factor=2.5,
          ttfs_factor, ttfs_floor_s, "warm time-to-first-step p50")
     if compared:
         verdict["service"] = compared
+
+
+def _compare_fleet(verdict, baseline, current, queue_factor=2.5,
+                   queue_floor_s=0.5, ttfs_factor=2.5,
+                   ttfs_floor_s=1.0):
+    """Fleet SLO comparison (mutates ``verdict`` in place): the fleet
+    ``legs`` of the ``fleet`` report section
+    (:mod:`pystella_tpu.obs.fleet` — each leg's windowed value at the
+    last aggregation pass, computed over EVERY replica's samples), held
+    to the same factor+floor bars as the single-replica service legs.
+    Also the fleet hygiene warnings: version/flag skew appearing when
+    the baseline fleet had none, warm-fingerprint divergence (the
+    hard precondition for cross-replica warm-artifact reuse), and
+    fleet-alert flap growth. Coverage loss (baseline had a fleet
+    section, current does not) degrades to a warning. The
+    partial-evidence refusal and the degraded annotation run earlier,
+    before any baseline is consulted."""
+    bfl = (baseline or {}).get("fleet") or {}
+    cfl = current.get("fleet") or {}
+    if bfl and not cfl:
+        verdict["warnings"].append(
+            "fleet: baseline carried a fleet section but the current "
+            "run has none — fleet SLO coverage was lost")
+        return
+    if not cfl:
+        return
+    # hygiene findings need no baseline: skew and divergence are
+    # absolute properties of THIS fleet
+    if (cfl.get("skew") or {}).get("skewed") \
+            and not (bfl.get("skew") or {}).get("skewed"):
+        verdict["warnings"].append(
+            "fleet: version/flag SKEW across live replicas "
+            f"({(cfl.get('skew') or {}).get('stacks')} distinct "
+            "compiler stacks) — fleet aggregates mix incomparable "
+            "programs; align the stacks before trusting fleet legs")
+    if cfl.get("divergence"):
+        verdict["warnings"].append(
+            "fleet: warm-fingerprint divergence across replicas for "
+            f"signature(s) {', '.join(cfl['divergence'])} — the same "
+            "signature is served by different programs; do not share "
+            "warm artifacts across this fleet")
+    if not bfl:
+        return
+    compared = {}
+
+    def _leg(name, factor, floor_s, what):
+        b = ((bfl.get("legs") or {}).get(name) or {}).get("value_fast")
+        c = ((cfl.get("legs") or {}).get(name) or {}).get("value_fast")
+        if not isinstance(b, (int, float)) or b < 0 \
+                or not isinstance(c, (int, float)):
+            if isinstance(b, (int, float)) and c is None:
+                verdict["warnings"].append(
+                    f"fleet: baseline tracked {what} but the current "
+                    "run's fleet section carries none — fleet SLO "
+                    "coverage was lost")
+            return
+        compared[name] = {"baseline_s": b, "current_s": c,
+                          "factor": factor, "floor_s": floor_s}
+        if c > b * factor and c - b > floor_s:
+            verdict.update(ok=False,
+                           exit_code=max(verdict["exit_code"], 1))
+            verdict["reasons"].append(
+                f"fleet SLO regression: {what} {c:.3g} s vs "
+                f"baseline {b:.3g} s (allowed factor {factor:g}, "
+                f"floor {floor_s:g} s) — see the report's fleet "
+                "section")
+        elif b > c * factor and b - c > floor_s:
+            verdict["warnings"].append(
+                f"fleet improvement: {what} {c:.3g} s vs baseline "
+                f"{b:.3g} s — consider refreshing the baseline")
+
+    _leg("queue_p95", queue_factor, queue_floor_s,
+         "fleet queue-latency p95")
+    _leg("warm_ttfs", ttfs_factor, ttfs_floor_s,
+         "fleet warm time-to-first-step p50")
+    b_flaps = (bfl.get("alerts") or {}).get("flaps")
+    c_flaps = (cfl.get("alerts") or {}).get("flaps")
+    if isinstance(b_flaps, int) and isinstance(c_flaps, int) \
+            and c_flaps > b_flaps:
+        verdict["warnings"].append(
+            f"fleet: {c_flaps} fleet alert flap(s) vs {b_flaps} in "
+            "the baseline — a fleet SLO oscillating around its bar")
+    if compared:
+        verdict["fleet"] = compared
 
 
 def _compare_latency(verdict, baseline, current, miss_factor=2.0,
@@ -1217,6 +1366,25 @@ def main(argv=None):
                    help="skip the request-latency checks (deadline-"
                         "miss SLO regression, span-assembly coverage "
                         "warnings)")
+    p.add_argument("--fleet-queue-factor", type=float, default=2.5,
+                   help="fleet: allowed multiple of the baseline's "
+                        "fleet queue-latency p95 before the gate "
+                        "fails (default 2.5)")
+    p.add_argument("--fleet-queue-floor", type=float, default=0.5,
+                   help="fleet: absolute seconds a fleet queue-p95 "
+                        "regression must also exceed (default 0.5)")
+    p.add_argument("--fleet-ttfs-factor", type=float, default=2.5,
+                   help="fleet: allowed multiple of the baseline's "
+                        "fleet warm-TTFS p50 before the gate fails "
+                        "(default 2.5)")
+    p.add_argument("--fleet-ttfs-floor", type=float, default=1.0,
+                   help="fleet: absolute seconds a fleet warm-TTFS "
+                        "regression must also exceed (default 1)")
+    p.add_argument("--no-fleet", action="store_true",
+                   help="skip the fleet checks (full-coverage-claim-"
+                        "over-lossy-scrapes refusal, degraded-fleet "
+                        "annotation, fleet queue-p95/warm-TTFS "
+                        "regressions, skew/divergence/flap warnings)")
     p.add_argument("--no-alerts", action="store_true",
                    help="skip the live-alert consistency audit (an "
                         "unresolved burn alert beside a green post-hoc "
@@ -1289,7 +1457,12 @@ def main(argv=None):
         check_latency=not args.no_latency,
         latency_miss_factor=args.latency_miss_factor,
         latency_miss_floor=args.latency_miss_floor,
-        check_alerts=not args.no_alerts)
+        check_alerts=not args.no_alerts,
+        check_fleet=not args.no_fleet,
+        fleet_queue_factor=args.fleet_queue_factor,
+        fleet_queue_floor_s=args.fleet_queue_floor,
+        fleet_ttfs_factor=args.fleet_ttfs_factor,
+        fleet_ttfs_floor_s=args.fleet_ttfs_floor)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

@@ -279,6 +279,12 @@ class PerfLedger:
         self.slo_events = []            # ("alert"|"resolved", ts, data)
         #                                 from the live burn-rate
         #                                 monitor (obs.slo) -> alerts()
+        self.fleet_scrapes = []         # fleet_scrape payloads, in order
+        self.fleet_lost = []            # fleet_replica_lost payloads
+        self.fleet_slo_events = []      # ("alert"|"resolved", ts, data)
+        #                                 from the fleet aggregator
+        self.fleet_announces = []       # fleet_announce payloads
+        self.fleet_withdraws = []       # fleet_withdraw payloads
 
     # -- ingestion ---------------------------------------------------------
 
@@ -480,6 +486,20 @@ class PerfLedger:
                 led.slo_events.append(("alert", ev.get("ts"), data))
             elif kind == "slo_resolved":
                 led.slo_events.append(("resolved", ev.get("ts"), data))
+            elif kind == "fleet_scrape":
+                led.fleet_scrapes.append(data)
+            elif kind == "fleet_replica_lost":
+                led.fleet_lost.append(data)
+            elif kind == "fleet_alert":
+                led.fleet_slo_events.append(("alert", ev.get("ts"),
+                                             data))
+            elif kind == "fleet_resolved":
+                led.fleet_slo_events.append(("resolved", ev.get("ts"),
+                                             data))
+            elif kind == "fleet_announce":
+                led.fleet_announces.append(data)
+            elif kind == "fleet_withdraw":
+                led.fleet_withdraws.append(data)
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -1222,49 +1242,75 @@ class PerfLedger:
         it)."""
         if not self.slo_events:
             return None
-        by_leg = {}
+        return _alert_rollup(self.slo_events)
 
-        def row(leg):
-            return by_leg.setdefault(str(leg), {
-                "alerts": 0, "resolved": 0, "flaps": 0,
-                "total_alert_s": 0.0, "max_alert_s": None,
-                "open": None})
-
-        for kind, ts, data in self.slo_events:
-            r = row(data.get("leg"))
-            if kind == "alert":
-                r["alerts"] += 1
-                r["flaps"] = max(0, r["alerts"] - 1)
-                r["open"] = {"since_ts": ts,
-                             "value": data.get("value"),
-                             "bar": data.get("bar"),
-                             "burn_fast": data.get("burn_fast"),
-                             "burn_slow": data.get("burn_slow")}
-            else:
-                r["resolved"] += 1
-                d = data.get("duration_s")
-                if d is None and r["open"] is not None \
-                        and isinstance(ts, (int, float)) \
-                        and isinstance(r["open"].get("since_ts"),
-                                       (int, float)):
-                    d = ts - r["open"]["since_ts"]
-                if isinstance(d, (int, float)):
-                    r["total_alert_s"] += float(d)
-                    r["max_alert_s"] = (float(d)
-                                        if r["max_alert_s"] is None
-                                        else max(r["max_alert_s"],
-                                                 float(d)))
-                r["open"] = None
-        unresolved = [{"leg": leg, **r["open"]}
-                      for leg, r in sorted(by_leg.items())
-                      if r["open"] is not None]
+    def fleet(self):
+        """The fleet federation summary (:mod:`pystella_tpu.obs.fleet`
+        aggregator over the replica registry): the replica table as of
+        the last scrape (each row annotated with heartbeat age and
+        per-replica scrape outcomes), the aggregated fleet SLO legs,
+        lost replicas, the scrape-success rate, skew/divergence
+        findings, and the fleet alert rollup (same shape as
+        :meth:`alerts`, built from ``fleet_alert``/``fleet_resolved``).
+        The ``coverage`` block is the gate's honesty anchor: a fleet
+        claim over a run with lost replicas or failed scrapes is a
+        claim over PARTIAL evidence, and ``complete`` says which kind
+        this run's record is. ``None`` when the run carried no fleet
+        telemetry at all."""
+        if not (self.fleet_scrapes or self.fleet_lost
+                or self.fleet_slo_events):
+            return None
+        replicas = {}
+        for sc in self.fleet_scrapes:
+            for row in sc.get("replicas") or []:
+                rid = row.get("replica")
+                if rid:
+                    replicas[rid] = dict(row)
+        lost_rows = []
+        for data in self.fleet_lost:
+            rid = data.get("replica")
+            lost_rows.append({"replica": rid,
+                              "reason": data.get("reason"),
+                              "age_s": data.get("age_s")})
+            if rid:
+                replicas.setdefault(rid, {"replica": rid})
+                replicas[rid]["status"] = "lost"
+                replicas[rid]["lost_reason"] = data.get("reason")
+        last = self.fleet_scrapes[-1] if self.fleet_scrapes else {}
+        ok = sum(int(sc.get("ok") or 0) for sc in self.fleet_scrapes)
+        failed = sum(int(sc.get("failed") or 0)
+                     for sc in self.fleet_scrapes)
+        attempts = ok + failed
+        lost_ids = sorted({r["replica"] for r in lost_rows
+                           if r.get("replica")})
         return {
-            "alerts": sum(r["alerts"] for r in by_leg.values()),
-            "resolved": sum(r["resolved"] for r in by_leg.values()),
-            "flaps": sum(r["flaps"] for r in by_leg.values()),
-            "unresolved": unresolved,
-            "by_leg": {leg: {k: v for k, v in r.items() if k != "open"}
-                       for leg, r in sorted(by_leg.items())},
+            "replicas": [replicas[rid] for rid in sorted(replicas)],
+            "scrapes": len(self.fleet_scrapes),
+            "endpoint_ok": ok,
+            "endpoint_failed": failed,
+            "scrape_success_rate": (ok / attempts if attempts
+                                    else None),
+            "replicas_lost": lost_rows,
+            "dead": last.get("dead"),
+            "legs": last.get("legs"),
+            "alerts": (_alert_rollup(self.fleet_slo_events)
+                       if self.fleet_slo_events else None),
+            "skew": {
+                "skewed": any(sc.get("skewed")
+                              for sc in self.fleet_scrapes),
+                "stacks": last.get("stacks"),
+            },
+            "divergence": sorted({sig for sc in self.fleet_scrapes
+                                  for sig in (sc.get("divergent")
+                                              or [])}),
+            "announces": len(self.fleet_announces),
+            "withdraws": len(self.fleet_withdraws),
+            "coverage": {
+                "replicas": len(replicas),
+                "lost": len(lost_ids),
+                "endpoint_failed": failed,
+                "complete": not lost_ids and failed == 0,
+            },
         }
 
     def latency(self):
@@ -1367,6 +1413,7 @@ class PerfLedger:
             "service": self.service(),
             "latency": self.latency(),
             "alerts": self.alerts(),
+            "fleet": self.fleet(),
             "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
@@ -1388,6 +1435,57 @@ class PerfLedger:
             f.write(render_markdown(rep))
         _events.emit("perf_report", path=json_path, label=self.label)
         return json_path
+
+
+def _alert_rollup(events):
+    """Per-leg fire/resolve bookkeeping over ``[("alert"|"resolved",
+    ts, data), ...]`` — one definition for both the live
+    (``slo_alert``) and fleet (``fleet_alert``) vocabularies, so their
+    report shapes cannot diverge."""
+    by_leg = {}
+
+    def row(leg):
+        return by_leg.setdefault(str(leg), {
+            "alerts": 0, "resolved": 0, "flaps": 0,
+            "total_alert_s": 0.0, "max_alert_s": None,
+            "open": None})
+
+    for kind, ts, data in events:
+        r = row(data.get("leg"))
+        if kind == "alert":
+            r["alerts"] += 1
+            r["flaps"] = max(0, r["alerts"] - 1)
+            r["open"] = {"since_ts": ts,
+                         "value": data.get("value"),
+                         "bar": data.get("bar"),
+                         "burn_fast": data.get("burn_fast"),
+                         "burn_slow": data.get("burn_slow")}
+        else:
+            r["resolved"] += 1
+            d = data.get("duration_s")
+            if d is None and r["open"] is not None \
+                    and isinstance(ts, (int, float)) \
+                    and isinstance(r["open"].get("since_ts"),
+                                   (int, float)):
+                d = ts - r["open"]["since_ts"]
+            if isinstance(d, (int, float)):
+                r["total_alert_s"] += float(d)
+                r["max_alert_s"] = (float(d)
+                                    if r["max_alert_s"] is None
+                                    else max(r["max_alert_s"],
+                                             float(d)))
+            r["open"] = None
+    unresolved = [{"leg": leg, **r["open"]}
+                  for leg, r in sorted(by_leg.items())
+                  if r["open"] is not None]
+    return {
+        "alerts": sum(r["alerts"] for r in by_leg.values()),
+        "resolved": sum(r["resolved"] for r in by_leg.values()),
+        "flaps": sum(r["flaps"] for r in by_leg.values()),
+        "unresolved": unresolved,
+        "by_leg": {leg: {k: v for k, v in r.items() if k != "open"}
+                   for leg, r in sorted(by_leg.items())},
+    }
 
 
 def _lat_stats(samples_s):
@@ -1880,6 +1978,66 @@ def render_markdown(rep):
                 f"{_fmt(r.get('total_alert_s'))} s alerting"
                 + (f" (max {_fmt(r.get('max_alert_s'))} s)"
                    if r.get("max_alert_s") is not None else ""))
+        lines.append("")
+    fl = rep.get("fleet")
+    if fl:
+        lines += ["## Fleet (replica registry + federation)", ""]
+        cov = fl.get("coverage") or {}
+        lines.append(
+            f"- {_fmt(cov.get('replicas'), '.0f', '0')} replica(s) "
+            f"seen, {_fmt(cov.get('lost'), '.0f', '0')} lost, "
+            f"{_fmt(fl.get('scrapes'), '.0f', '0')} aggregation "
+            f"pass(es), scrape success "
+            f"{_fmt(fl.get('scrape_success_rate'), '.0%')} "
+            f"({'complete' if cov.get('complete') else 'PARTIAL'} "
+            "coverage)")
+        rows = fl.get("replicas") or []
+        if rows:
+            lines += ["", "| replica | status | heartbeat age s "
+                      "| queue | fingerprint |", "|---|---|---|---|---|"]
+            for row in rows:
+                lines.append(
+                    f"| `{row.get('replica')}` | {row.get('status')} "
+                    f"| {_fmt(row.get('age_s'))} "
+                    f"| {_fmt(row.get('queue_depth'), '.0f')} "
+                    f"| `{row.get('fingerprint') or '—'}` |")
+            lines.append("")
+        for rec in fl.get("replicas_lost") or []:
+            lines.append(
+                f"- **replica lost**: `{rec.get('replica')}` "
+                f"({rec.get('reason')}) — the fleet verdict is "
+                "degraded, not silently averaged over the survivors")
+        legs = fl.get("legs") or {}
+        if legs:
+            lines += ["", "| fleet leg | value | bar | alerting |",
+                      "|---|---|---|---|"]
+            for name, leg in sorted(legs.items()):
+                lines.append(
+                    f"| `{name}` | {_fmt(leg.get('value_fast'))} "
+                    f"| {_fmt(leg.get('bar'))} "
+                    f"| {'YES' if leg.get('alerting') else 'no'} |")
+            lines.append("")
+        fal = fl.get("alerts")
+        if fal:
+            lines.append(
+                f"- fleet alerts: {_fmt(fal.get('alerts'), '.0f', '0')} "
+                f"fired, {_fmt(fal.get('resolved'), '.0f', '0')} "
+                f"resolved, {_fmt(fal.get('flaps'), '.0f', '0')} "
+                "flap(s)")
+            for rec in fal.get("unresolved") or []:
+                lines.append(
+                    f"- **UNRESOLVED at exit**: fleet `{rec.get('leg')}` "
+                    f"burning at {_fmt(rec.get('value'))} vs bar "
+                    f"{_fmt(rec.get('bar'))}")
+        skew = fl.get("skew") or {}
+        if skew.get("skewed"):
+            lines.append(
+                f"- **version/flag SKEW**: {skew.get('stacks')} "
+                "distinct compiler stacks across live replicas")
+        if fl.get("divergence"):
+            lines.append(
+                "- **warm-fingerprint divergence**: "
+                + ", ".join(f"`{s}`" for s in fl["divergence"]))
         lines.append("")
     ff = rep.get("fft")
     if ff:

@@ -47,9 +47,11 @@ one standalone around any instrumented loop::
 
 from __future__ import annotations
 
+import hashlib
 import json
 import math
 import re
+import sys
 import threading
 import time
 
@@ -59,7 +61,8 @@ from pystella_tpu import config as _config
 from pystella_tpu.obs import events as _events
 from pystella_tpu.obs import metrics as _metrics
 
-__all__ = ["LiveServer", "render_prometheus", "start_from_env"]
+__all__ = ["LiveServer", "build_info_labels", "render_prometheus",
+           "start_from_env"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -83,6 +86,46 @@ def _prom_value(v):
     return format(float(v), ".10g")
 
 
+#: (versions, flags digest) computed once per process — the compiler
+#: stack cannot change under a running server, and importlib.metadata
+#: lookups are too slow for a per-scrape path
+_BUILD_INFO_STATIC = None
+
+
+def build_info_labels():
+    """The label set of the ``pystella_build_info`` gauge: the
+    jax/jaxlib/libtpu version triple, the scheduler-relevant flag
+    fingerprint digest (:func:`pystella_tpu.parallel.overlap.
+    flags_fingerprint`), and the device kind of an already-imported
+    jax. This is the skew-detection surface — a fleet aggregator can
+    compare stacks from the exposition alone, no registry read
+    required. Absent values render as ``"none"`` so the label set is
+    stable across environments."""
+    global _BUILD_INFO_STATIC
+    if _BUILD_INFO_STATIC is None:
+        from pystella_tpu.obs import ledger as _ledger
+        from pystella_tpu.parallel.overlap import flags_fingerprint
+        versions = _ledger.runtime_versions()
+        digest = hashlib.sha256(json.dumps(
+            flags_fingerprint(), sort_keys=True).encode()).hexdigest()[:12]
+        _BUILD_INFO_STATIC = (versions, digest)
+    versions, digest = _BUILD_INFO_STATIC
+    device_kind = "none"
+    jax = sys.modules.get("jax")  # never import jax for a scrape
+    if jax is not None:
+        try:
+            device_kind = str(jax.devices()[0].device_kind)
+        except Exception:  # noqa: BLE001 — a scrape must not kill it
+            pass
+    return {
+        "jax": versions.get("jax") or "none",
+        "jaxlib": versions.get("jaxlib") or "none",
+        "libtpu": versions.get("libtpu") or "none",
+        "flags_fingerprint": digest,
+        "device_kind": device_kind,
+    }
+
+
 def render_prometheus(registry=None, status=None):
     """The ``/metrics`` body: the registry's typed snapshot plus the
     service-status gauges, Prometheus text format. Pure function of its
@@ -98,6 +141,12 @@ def render_prometheus(registry=None, status=None):
                               for k, v in sorted(labels.items())) + "}"
                if labels else "")
         lines.append(f"{name}{tag} {_prom_value(value)}")
+
+    metric("pystella_build_info", "gauge", 1.0,
+           labels=build_info_labels(),
+           help="constant 1; the labels carry the replica's compiler "
+                "stack (versions, flag fingerprint, device kind) for "
+                "fleet skew detection")
 
     for key, (value, kind) in reg.snapshot_typed().items():
         metric(_prom_name(key), kind, value)
@@ -214,7 +263,14 @@ class LiveServer:
             ("127.0.0.1", int(port) if port else 0), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.live = self
+        # the bind happens inside ThreadingHTTPServer.__init__, so the
+        # resolved port — ephemeral included — is final HERE, before
+        # start(): a replica registry may publish url() at announce
+        # time without racing the first scrape
         self.port = int(self._httpd.server_port)
+        if self.port <= 0:
+            raise OSError(f"live endpoint bound no port (got "
+                          f"{self.port}); cannot publish a URL")
         self._thread = None
 
     # -- payloads (also the test seam: no socket required) ------------------
@@ -261,12 +317,15 @@ class LiveServer:
                 target=self._httpd.serve_forever,
                 name=f"pystella-live:{self.port}", daemon=True)
             self._thread.start()
-            _events.emit("live_serve", port=self.port,
+            _events.emit("live_serve", port=self.port, url=self.url(),
                          endpoints=["/metrics", "/healthz", "/slo"],
                          label=self.label)
         return self
 
     def url(self, path="/"):
+        """The endpoint URL — valid from construction (the port is
+        bound in ``__init__``), so it can be published before
+        :meth:`start`."""
         return f"http://127.0.0.1:{self.port}{path}"
 
     def close(self):
@@ -284,17 +343,23 @@ class LiveServer:
         self.close()
 
 
-def start_from_env(service=None, slo=None, registry=None, label="live"):
+def start_from_env(service=None, slo=None, registry=None, label="live",
+                   port=None):
     """Start a :class:`LiveServer` when the registered
     ``PYSTELLA_LIVE_PORT`` names a port; return ``None`` when it is
-    0/unset (the live plane is strictly opt-in). A port that cannot be
-    bound degrades to ``None`` with a stderr warning — live telemetry
-    must never kill the serving process."""
-    port = _config.get_int("PYSTELLA_LIVE_PORT") or 0
-    if port <= 0:
+    0/unset (the live plane is strictly opt-in). An explicit ``port``
+    overrides the environment: an int binds that port, ``"auto"``
+    binds an ephemeral one (two in-process replicas cannot share one
+    env var — the fleet drill passes ``"auto"`` per replica). A port
+    that cannot be bound degrades to ``None`` with a stderr warning —
+    live telemetry must never kill the serving process."""
+    if port is None:
+        port = _config.get_int("PYSTELLA_LIVE_PORT") or 0
+    if port != "auto" and int(port) <= 0:
         return None
     try:
-        return LiveServer(port=port, service=service, slo=slo,
+        return LiveServer(port=None if port == "auto" else int(port),
+                          service=service, slo=slo,
                           registry=registry, label=label).start()
     except (OSError, OverflowError, ValueError) as e:
         # OSError: port in use / no permission; OverflowError: a port

@@ -96,6 +96,11 @@ DEFAULT_LEGS = {
 #: must not grow without bound even with generous windows
 _MAX_SAMPLES = 4096
 
+#: per-leg exposition history on ``/slo`` (``state()["samples"]``) —
+#: bounded separately from the window deque so window caps do not hide
+#: samples from federation readers
+_RECENT_SAMPLES = 256
+
 
 def leg_bar(objective, factor, floor):
     """The alert bar for one leg: the gate fails when ``current >
@@ -137,6 +142,11 @@ class _LegState:
         ws = spec.get("window_samples")
         maxlen = min(_MAX_SAMPLES, int(ws)) if ws else _MAX_SAMPLES
         self.samples = collections.deque(maxlen=maxlen)
+        # exposition history, decoupled from the window deque: a
+        # window_samples=1 leg still shows its recent samples on /slo,
+        # so a fleet aggregator scraping after fire+resolve can ingest
+        # BOTH verdicts instead of only the survivor
+        self.recent = collections.deque(maxlen=_RECENT_SAMPLES)
         self.bar = leg_bar(self.objective, self.factor, self.floor)
         self.alerting = False
         self.fired_ts = None
@@ -147,6 +157,7 @@ class _LegState:
 
     def add(self, ts, value):
         self.samples.append((float(ts), float(value)))
+        self.recent.append((float(ts), float(value)))
 
     def evaluate(self, now):
         """Windowed values + the fire/resolve transition (if any);
@@ -200,6 +211,7 @@ class _LegState:
             "alerts": self.alerts, "resolved": self.resolved,
             "flaps": self.flaps,
             "total_alert_s": round(self.total_alert_s, 6),
+            "samples": [[round(ts, 6), v] for ts, v in self.recent],
             **self.last,
         }
 
@@ -284,6 +296,26 @@ class SLOMonitor:
                 touched = True
         if touched:
             self.evaluate(now=ts)
+
+    # -- direct ingestion (federation seam) ----------------------------------
+
+    def add_sample(self, leg, value, ts=None, evaluate=True):
+        """Feed one ``(ts, value)`` sample straight into a leg's
+        window, bypassing the event-kind routing of :meth:`handle`.
+        This is the federation seam: :class:`~pystella_tpu.obs.fleet.
+        FleetAggregator` replays per-replica ``/slo`` samples through
+        a fleet-level monitor with the same window machinery. Unknown
+        legs raise ``KeyError``; ``evaluate=True`` (default) runs the
+        fire/resolve state machine at the sample's timestamp and
+        returns its transitions (``[]`` otherwise)."""
+        state = self._legs[leg]
+        ts = time.time() if ts is None else float(ts)
+        with self._lock:
+            state.add(ts, float(value))
+        self.ingested += 1
+        if evaluate:
+            return self.evaluate(now=ts)
+        return []
 
     # -- evaluation ----------------------------------------------------------
 

@@ -28,6 +28,13 @@ and prints one line of serve-loop state and SLO burn; when no endpoint
 is reachable it falls back to re-reading the rotated event-log family
 per tick — the offline reconstruction, repeated — so the same command
 tails a live server, a server without the live plane, and a dead one.
+
+``status --fleet`` widens the view from one replica to the whole
+fleet: each tick reads the replica registry (``--fleet-dir`` or the
+registered ``PYSTELLA_FLEET_DIR``), classifies every record
+live/stale/withdrawn by heartbeat age, and polls each live replica's
+own endpoint for one serve-loop + SLO line — a per-replica table of
+everything currently announced. Combine with ``--follow`` to tail it.
 """
 
 from __future__ import annotations
@@ -40,7 +47,7 @@ import time
 from pystella_tpu import config as _config
 from pystella_tpu.obs import events as _events
 
-__all__ = ["follow_line", "reconstruct", "main"]
+__all__ = ["fleet_lines", "follow_line", "reconstruct", "main"]
 
 
 def reconstruct(events_path):
@@ -242,6 +249,35 @@ def follow_line(healthz, slo):
            else ("ok" if slo.get("enabled") else "off")))
 
 
+def fleet_lines(fleet_dir, expire_s=None, poll=_live_poll):
+    """One fleet-status tick: read the replica registry, classify every
+    record by heartbeat age, and poll each LIVE replica's own endpoint
+    for its serve-loop + SLO line. Pure function of the registry plus
+    ``poll`` (injectable for tests); returns the rendered lines."""
+    from pystella_tpu.service import registry as _registry
+    recs = _registry.read_records(fleet_dir, expire_s=expire_s)
+    if not recs:
+        return [f"fleet: no replica records under {fleet_dir}"]
+    live = sum(1 for r in recs if r.get("status") == "live")
+    lines = [f"fleet: {live}/{len(recs)} replica(s) live "
+             f"({fleet_dir})"]
+    for rec in sorted(recs, key=lambda r: str(r.get("replica"))):
+        status = rec.get("status")
+        age = rec.get("age_s")
+        line = (f"  {rec.get('replica')} [{status}]"
+                + (f" age {age:.1f}s" if isinstance(age, (int, float))
+                   else ""))
+        url = rec.get("url")
+        if status == "live" and url:
+            polled = poll(url)
+            line += (" · endpoint UNREACHABLE" if polled is None
+                     else " · " + follow_line(*polled))
+        elif url:
+            line += f" · {url}"
+        lines.append(line)
+    return lines
+
+
 def _offline_line(events_path):
     state = reconstruct(events_path)
     leases = state["leases"]
@@ -252,25 +288,31 @@ def _offline_line(events_path):
             + (" · serve loop FINISHED" if state["done"] else ""))
 
 
-def _follow(events_path, url, interval, count):
+def _follow(events_path, url, interval, count, fleet_dir=None):
     """The live-tail loop: poll the endpoint when one is configured
     (falling back per tick when it is unreachable — the server may not
     be up yet, or just went down), else re-read the event-log family.
-    ``count`` bounds the ticks (0 = forever)."""
+    With ``fleet_dir`` each tick renders the per-replica fleet table
+    instead of the single-endpoint line. ``count`` bounds the ticks
+    (0 = forever)."""
     ticks = 0
     while True:
-        line = None
-        if url:
-            polled = _live_poll(url)
-            if polled is not None:
-                line = follow_line(*polled)
-        if line is None:
-            if not events_path:
-                print("service status --follow: live endpoint "
-                      "unreachable and no --events/PYSTELLA_EVENT_LOG "
-                      "to fall back to", file=sys.stderr)
-                return 2
-            line = _offline_line(events_path)
+        if fleet_dir:
+            line = "\n".join(fleet_lines(fleet_dir))
+        else:
+            line = None
+            if url:
+                polled = _live_poll(url)
+                if polled is not None:
+                    line = follow_line(*polled)
+            if line is None:
+                if not events_path:
+                    print("service status --follow: live endpoint "
+                          "unreachable and no --events/"
+                          "PYSTELLA_EVENT_LOG to fall back to",
+                          file=sys.stderr)
+                    return 2
+                line = _offline_line(events_path)
         print(time.strftime("%H:%M:%S") + " " + line, flush=True)
         ticks += 1
         if count and ticks >= count:
@@ -311,15 +353,36 @@ def main(argv=None):
     ps.add_argument("--count", type=int, default=0,
                     help="--follow tick budget, 0 = follow forever "
                          "(default)")
+    ps.add_argument("--fleet", action="store_true",
+                    help="fleet view: read the replica registry "
+                         "(--fleet-dir or PYSTELLA_FLEET_DIR), "
+                         "classify every record live/stale/withdrawn "
+                         "by heartbeat age, and poll each live "
+                         "replica's own endpoint — one row per "
+                         "replica; combine with --follow to tail it")
+    ps.add_argument("--fleet-dir", default=None,
+                    help="replica registry directory (default: the "
+                         "registered PYSTELLA_FLEET_DIR)")
     args = p.parse_args(argv)
 
     events_path = args.events or _config.getenv("PYSTELLA_EVENT_LOG")
+    fleet_dir = None
+    if args.fleet or args.fleet_dir:
+        fleet_dir = args.fleet_dir or _config.getenv("PYSTELLA_FLEET_DIR")
+        if not fleet_dir:
+            print("service status --fleet: no --fleet-dir and no "
+                  "PYSTELLA_FLEET_DIR set", file=sys.stderr)
+            return 2
     if args.follow:
         url = args.url
         if url is None:
             port = _config.get_int("PYSTELLA_LIVE_PORT") or 0
             url = f"http://127.0.0.1:{port}" if port > 0 else None
-        return _follow(events_path, url, args.interval, args.count)
+        return _follow(events_path, url, args.interval, args.count,
+                       fleet_dir=fleet_dir)
+    if fleet_dir:
+        print("\n".join(fleet_lines(fleet_dir)))
+        return 0
     if not events_path:
         print("service status: no --events and no PYSTELLA_EVENT_LOG "
               "set", file=sys.stderr)

@@ -35,10 +35,22 @@ TPU-window ``service`` leg scales it up):
 Everything lands in the configured event log; the perf ledger's
 ``service``/``latency``/``alerts`` sections and the gate's SLO + alert
 verdicts consume it from there.
+
+:func:`run_fleet` is the fleet-plane counterpart: a deterministic
+TWO-replica drill — two in-process services with their own ephemeral
+live endpoints and registry records, a split tenant mix, a
+:class:`~pystella_tpu.obs.fleet.FleetAggregator` federating both, and
+one replica killed mid-run (no tombstone) so the aggregator's expiry
+path, the ``fleet_replica_lost`` record, and the unresolved
+``dead_replicas`` fleet alert are all produced by real machinery in a
+seconds-long run. The ledger's ``fleet`` section and the gate's fleet
+verdicts are pinned against exactly this record in tier-1.
 """
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 
 import numpy as np
@@ -51,7 +63,8 @@ from pystella_tpu.service.queue import (
 from pystella_tpu.service.results import ResultEmitter
 from pystella_tpu.service.server import ScenarioService
 
-__all__ = ["run", "build_preheat_model", "seeded_slo_monitor"]
+__all__ = ["run", "run_fleet", "build_preheat_model",
+           "seeded_slo_monitor", "seeded_fleet_legs"]
 
 
 def seeded_slo_monitor(label="loadgen"):
@@ -70,6 +83,26 @@ def seeded_slo_monitor(label="loadgen"):
         "deadline_miss": {"window_samples": 1, "min_samples": 1},
         "incident_rate": {},
     }, label=label)
+
+
+def seeded_fleet_legs():
+    """The fleet drill's deterministic
+    :class:`~pystella_tpu.obs.fleet.FleetAggregator` leg
+    configuration, mirroring :func:`seeded_slo_monitor`: the
+    ``deadline_miss`` leg is windowed to the last federated sample so
+    replica-a's one guaranteed miss fires the FLEET alert and its one
+    guaranteed hit resolves it within a single aggregation pass; the
+    queue/TTFS legs run with objectives no smoke mix can breach (the
+    federation ingest path is exercised, they never fire); and
+    ``dead_replicas`` keeps its zero bar — the killed replica's expiry
+    is the drill's one certain unresolved fleet alert."""
+    return {
+        "queue_p95": {"objective": 120.0},
+        "warm_ttfs": {"objective": 120.0},
+        "deadline_miss": {"window_samples": 1, "min_samples": 1},
+        "incident_rate": {},
+        "dead_replicas": {},
+    }
 
 
 def build_preheat_model(dtype=np.float32):
@@ -300,6 +333,224 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
                                   / max(serve_wall_s, 1e-9), 4),
         }
     _events.emit("service_loadgen", seed=seed, **stats)
+    return stats
+
+
+def run_fleet(workdir, grid=12, nsteps=4, slots=1, chunk=2,
+              heartbeat_s=0.1, expire_s=0.5, label="fleet-drill"):
+    """The deterministic two-replica fleet drill (module docstring).
+
+    Two in-process :class:`~pystella_tpu.service.ScenarioService`
+    replicas (``replica-a``, ``replica-b``) serve a split tenant mix
+    — a: ``alpha``/``bravo`` with both deadline polarities (the
+    seeded SLO story of :func:`run`), b: ``delta``/``echo`` — each
+    with its own ephemeral live endpoint (``live_port="auto"``) and
+    registry record under ``<workdir>/registry``. The orchestration
+    rides the event log's synchronous subscriber channel: a
+    subscriber callback BLOCKS a replica's serve thread at a chosen
+    event (b at its first retire, a at its ``service_done``, which is
+    emitted while the live plane is still up), so the aggregation
+    passes run against two replicas that are provably mid-serve —
+    no sleep-and-hope scheduling.
+
+    The drill then takes b down in the shape of a real wedge-then-
+    crash: its endpoint closes first and one scrape records the
+    live-but-unreachable failure against the still-beating record
+    (the failed-scrape evidence), then the crash seam
+    (:meth:`~pystella_tpu.service.registry.ReplicaRegistry.kill` — no
+    tombstone) stops the heartbeats, and the drill scrapes past the
+    expiry until the aggregator declares b LOST (reason
+    ``"expired"``): ``fleet_replica_lost`` plus the unresolved
+    ``dead_replicas`` fleet alert. Replica a withdraws
+    cleanly (tombstone), so the final registry distinguishes the
+    shutdown from the crash. Returns the stats dict (also emitted as
+    ``fleet_loadgen``); every ``fleet_*`` event lands in the
+    configured event log for the ledger's ``fleet`` section and the
+    gate's fleet verdicts.
+
+    ``heartbeat_s``/``expire_s`` default to drill-fast values (0.1 s
+    beats, 0.5 s expiry) — the production defaults live in the
+    registered ``PYSTELLA_FLEET_*`` knobs.
+    """
+    from pystella_tpu.obs import fleet as _fleet
+    from pystella_tpu.service import registry as _registry
+
+    t0 = time.perf_counter()
+    workdir = os.path.abspath(str(workdir))
+    registry_dir = os.path.join(workdir, "registry")
+    env_names = ("PYSTELLA_FLEET_DIR", "PYSTELLA_FLEET_HEARTBEAT_S")
+    # the services read both knobs through config.getenv at serve
+    # time; two in-process replicas share the process env, so the
+    # drill pins it for the duration and restores the caller's values
+    # env-registry: PYSTELLA_FLEET_DIR, PYSTELLA_FLEET_HEARTBEAT_S
+    prior = {n: os.environ.get(n) for n in env_names}
+    os.environ["PYSTELLA_FLEET_DIR"] = registry_dir
+    os.environ["PYSTELLA_FLEET_HEARTBEAT_S"] = str(float(heartbeat_s))
+
+    warm_sig = request_signature("preheat", (grid,) * 3)
+    svc_a = ScenarioService(
+        os.path.join(workdir, "ckpt-a"), slots=slots, chunk=chunk,
+        slo=seeded_slo_monitor(label="replica-a"),
+        label="replica-a", live_port="auto", fleet_id="replica-a")
+    # replica-b carries NO deadline leg: its monitor sees replica-a's
+    # retire events through the shared process log, and a second copy
+    # of the deadline samples on b's /slo would federate as a
+    # fire/resolve/fire flap at fleet level
+    svc_b = ScenarioService(
+        os.path.join(workdir, "ckpt-b"), slots=slots, chunk=chunk,
+        slo=_slo.SLOMonitor(legs={
+            "queue_p95": {"objective": 120.0},
+            "warm_ttfs": {"objective": 120.0},
+            "incident_rate": {},
+        }, label="replica-b"),
+        label="replica-b", live_port="auto", fleet_id="replica-b")
+    for svc in (svc_a, svc_b):
+        svc.register_model("preheat", build_preheat_model())
+        svc.arm(warm_sig)
+
+    # the pause points: a subscriber callback runs synchronously on
+    # the EMITTING thread, so waiting on a gate inside it holds that
+    # replica's serve loop at the event — mid-lease for b, live-plane-
+    # still-up for a — while the main thread aggregates
+    b_seen, b_gate = threading.Event(), threading.Event()
+    a_done, a_gate = threading.Event(), threading.Event()
+
+    def orchestrate(rec):
+        kind = rec.get("kind")
+        data = rec.get("data") or {}
+        if (kind == "member_result"
+                and data.get("label") == "replica-b"
+                and not b_seen.is_set()):
+            b_seen.set()
+            b_gate.wait(timeout=120.0)
+        elif (kind == "service_done"
+                and data.get("label") == "replica-a"
+                and not a_done.is_set()):
+            a_done.set()
+            a_gate.wait(timeout=120.0)
+
+    _events.get_log().subscribe(orchestrate)
+    summaries, errors = {}, {}
+
+    def serve_in_thread(name, svc):
+        try:
+            summaries[name] = svc.serve()
+        except Exception as e:  # noqa: BLE001 — reported after join
+            errors[name] = e
+
+    thread_a = thread_b = None
+    try:
+        # -- replica-b up first: mid-serve by its first retire --------
+        for req in (ScenarioRequest("delta", warm_sig, nsteps, seed=21),
+                    ScenarioRequest("echo", warm_sig, nsteps, seed=22)):
+            svc_b.submit(req)
+        thread_b = threading.Thread(
+            target=serve_in_thread, args=("b", svc_b),
+            name="fleet-drill-b", daemon=True)
+        thread_b.start()
+        if not b_seen.wait(timeout=120.0):
+            raise RuntimeError(
+                "fleet drill: replica-b never retired a member")
+
+        # -- replica-a: the seeded deadline mix (slots=1 leases the
+        # requests one at a time; fair-share picks bravo's EDF-first
+        # miss, then alpha, then bravo's hit — miss fires the alert,
+        # hit resolves it, deterministically)
+        for req in (ScenarioRequest("bravo", warm_sig, nsteps, seed=11,
+                                    deadline_s=0.02),
+                    ScenarioRequest("alpha", warm_sig, nsteps, seed=12),
+                    ScenarioRequest("bravo", warm_sig, nsteps, seed=13,
+                                    deadline_s=60.0)):
+            svc_a.submit(req)
+        thread_a = threading.Thread(
+            target=serve_in_thread, args=("a", svc_a),
+            name="fleet-drill-a", daemon=True)
+        thread_a.start()
+        if not a_done.wait(timeout=120.0):
+            raise RuntimeError(
+                "fleet drill: replica-a never finished its mix")
+
+        # -- aggregation pass 1: both replicas provably live ----------
+        agg = _fleet.FleetAggregator(
+            registry_dir=registry_dir, expire_s=expire_s,
+            legs=seeded_fleet_legs(), label=label)
+        both_live = agg.scrape()
+        queue_gauge_replicas = sorted(
+            both_live["gauges"].get("pystella_service_queue_depth", {}))
+
+        # -- the mid-run kill, staged like a real wedge-then-crash:
+        # b's endpoint dies first (close blocks ~0.5 s on the serve
+        # poll, so the record KEEPS beating past it), one scrape
+        # records the live-but-unreachable failure, then the crash
+        # seam stops the heartbeats; b's serve loop drains out
+        svc_b.live_server.close()
+        agg.scrape()
+        svc_b.fleet_registry.kill()
+        b_gate.set()
+        thread_b.join(timeout=120.0)
+
+        # -- expiry: b's record goes stale, the aggregator declares it
+        # LOST and the dead_replicas fleet alert fires (unresolved)
+        time.sleep(expire_s + 0.3)
+        final = agg.scrape()
+        for _ in range(50):
+            if final["dead"]:
+                break
+            time.sleep(0.1)
+            final = agg.scrape()
+
+        # -- replica-a withdraws cleanly (tombstone) ------------------
+        a_gate.set()
+        thread_a.join(timeout=120.0)
+        if errors:
+            name, err = sorted(errors.items())[0]
+            raise RuntimeError(
+                f"fleet drill: replica-{name} serve failed: {err}") \
+                from err
+    finally:
+        # release any still-held gate before unwinding so a failed
+        # drill cannot leave a serve thread parked in the subscriber
+        b_gate.set()
+        a_gate.set()
+        _events.get_log().unsubscribe(orchestrate)
+        for name, value in prior.items():
+            if value is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = value
+
+    records = _registry.read_records(registry_dir, expire_s=expire_s)
+    stats = {
+        "label": label,
+        "registry_dir": registry_dir,
+        "replicas": ["replica-a", "replica-b"],
+        "killed": "replica-b",
+        "completed": {
+            "replica-a": summaries.get("a", {}).get("completed"),
+            "replica-b": summaries.get("b", {}).get("completed")},
+        "live_both_pass": both_live["live"],
+        "queue_gauge_replicas": queue_gauge_replicas,
+        "scrapes": final["scrapes"],
+        "endpoint_ok": final["endpoint_ok"],
+        "endpoint_failed": final["endpoint_failed"],
+        "scrape_success_rate": final["scrape_success_rate"],
+        "lost": final["lost"],
+        "dead": final["dead"],
+        "alerts": final["alerts_total"],
+        "resolved": final["resolved_total"],
+        "flaps": final["flaps_total"],
+        "alerting": final["alerting"],
+        "legs": {name: {"value_fast": leg.get("value_fast"),
+                        "bar": leg.get("bar"),
+                        "n_slow": leg.get("n_slow"),
+                        "alerting": leg.get("alerting")}
+                 for name, leg in final["legs"].items()},
+        "skewed": final["skew"]["skewed"],
+        "divergent": sorted(final["divergence"]["divergent"]),
+        "registry": {r["replica"]: r["status"] for r in records},
+        "wall_s": round(time.perf_counter() - t0, 4),
+    }
+    _events.emit("fleet_loadgen", **stats)
     return stats
 
 

@@ -1,0 +1,284 @@
+"""Replica registry: the fleet's membership plane, stdlib-only.
+
+A fleet is N :class:`~pystella_tpu.service.ScenarioService` replicas
+serving as one logical service. Before anything can be aggregated,
+routed, or compared across them, something has to answer *who is in
+the fleet right now* — and answer it without a coordination service,
+because the serving path must not grow a dependency. This module is
+that answer, built on the one primitive every deployment already
+shares: a directory.
+
+**Writer side.** Each serving replica owns one JSON record file
+``<PYSTELLA_FLEET_DIR>/<replica_id>.json`` and rewrites it atomically
+(tmp file + ``os.replace``) at the registered
+``PYSTELLA_FLEET_HEARTBEAT_S`` cadence. The record carries everything
+a fleet reader needs to aggregate or to refuse to: the replica id,
+the live-endpoint URL (:mod:`pystella_tpu.obs.live` — the URL is
+valid at announce time because the endpoint binds its port in its
+constructor), the device kind, the jax/jaxlib/libtpu version triple
+plus scheduler-relevant flag fingerprint (digested into one
+``fingerprint`` skew key), the warm-pool signature fingerprints (the
+safety precondition for cross-replica warm-artifact reuse), the queue
+depth, and the serving state. A replica that exits cleanly writes a
+final tombstone (``withdrawn: true``) so readers can tell a shutdown
+from a crash; a crashed replica simply stops beating, and readers
+expire its record by heartbeat age (``PYSTELLA_FLEET_EXPIRE_S``).
+
+**Reader side.** :func:`read_records` returns every parseable record
+annotated with its heartbeat age and a derived ``status`` —
+``"live"``, ``"stale"`` (expired heartbeat: presumed crashed), or
+``"withdrawn"``. :class:`~pystella_tpu.obs.fleet.FleetAggregator` and
+``python -m pystella_tpu.service status --fleet`` both read through
+this one function so membership semantics cannot fork.
+
+Opt-in end to end: :meth:`ScenarioService.serve
+<pystella_tpu.service.ScenarioService.serve>` announces/withdraws
+automatically only when ``PYSTELLA_FLEET_DIR`` is set. The drill seam
+:meth:`ReplicaRegistry.kill` abandons the record *without* a
+tombstone — a simulated crash, used by the two-replica fleet drill so
+the aggregator's expiry path is exercised by tier-1 evidence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import socket
+import threading
+import time
+
+from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import ledger as _ledger
+
+__all__ = ["ReplicaRegistry", "read_records", "stack_fingerprint",
+           "service_status_record"]
+
+#: per-process announce counter: two in-process replicas share a pid,
+#: so default replica ids need a process-local discriminator
+_SEQ = itertools.count()
+
+
+def stack_fingerprint(versions=None, flags=None):
+    """One short digest over the compiler stack (version triple +
+    scheduler-relevant flags) — the skew key: two replicas whose
+    fingerprints differ are not interchangeable for warm-artifact
+    reuse or apples-to-apples perf comparison."""
+    if versions is None:
+        versions = _ledger.runtime_versions()
+    if flags is None:
+        flags = _ledger.xla_flag_fingerprint()
+    blob = json.dumps({"versions": versions, "flags": flags},
+                      sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _device_kind():
+    """Device kind from an already-imported jax only — announcing a
+    replica must never trigger backend init."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return str(jax.devices()[0].device_kind)
+        except Exception:  # noqa: BLE001 — membership must not raise
+            pass
+    return None
+
+
+def service_status_record(service):
+    """The dynamic record fields read off a live
+    :class:`~pystella_tpu.service.ScenarioService` — called at
+    announce time and again on every heartbeat, so readers see queue
+    depth and warm fingerprints at most one beat old."""
+    status = service.live_status()
+    warm = {}
+    for sig in service.pool.signatures():
+        entry = service.pool.get(sig)
+        fp = getattr(entry, "fingerprint", None)
+        if fp:
+            warm[str(sig)] = fp
+    return {
+        "serving": bool(status.get("serving")),
+        "queue_depth": status.get("queue_depth"),
+        "leases_completed": status.get("leases_completed"),
+        "completed": status.get("completed"),
+        "warm_fingerprints": warm,
+    }
+
+
+class ReplicaRegistry:
+    """One replica's registry membership (module docstring).
+
+    :arg root: the shared registry directory (created if missing).
+    :arg replica_id: record identity; default derives from ``label``,
+        pid, and a process-local counter so two in-process replicas
+        never collide.
+    :arg heartbeat_s: beat cadence; ``None`` reads the registered
+        ``PYSTELLA_FLEET_HEARTBEAT_S``; ``<= 0`` announces once and
+        never beats (tests drive :meth:`heartbeat` by hand).
+    :arg status_fn: optional zero-arg callable returning record fields
+        to merge on every beat (the service passes a
+        :func:`service_status_record` closure). A raising status_fn is
+        swallowed — a heartbeat must never kill serving.
+    :arg label: carried on the record and the default replica id.
+    """
+
+    def __init__(self, root, replica_id=None, heartbeat_s=None,
+                 status_fn=None, label="replica"):
+        self.root = os.path.abspath(str(root))
+        os.makedirs(self.root, exist_ok=True)
+        if heartbeat_s is None:
+            heartbeat_s = _config.get_float("PYSTELLA_FLEET_HEARTBEAT_S")
+        self.heartbeat_s = float(heartbeat_s)
+        self.label = str(label)
+        self.replica_id = (str(replica_id) if replica_id else
+                           f"{self.label}-{os.getpid()}-{next(_SEQ)}")
+        self.status_fn = status_fn
+        self.record = {}
+        self.heartbeats = 0
+        self.killed = False
+        self._stop = threading.Event()
+        self._thread = None
+
+    @property
+    def path(self):
+        return os.path.join(self.root, self.replica_id + ".json")
+
+    # -- writer lifecycle ----------------------------------------------------
+
+    def announce(self, **fields):
+        """Publish the record (identity + stack fingerprint + any
+        ``fields``, e.g. ``url=...``) and start the heartbeat thread.
+        Returns ``self``."""
+        versions = _ledger.runtime_versions()
+        flags = _ledger.xla_flag_fingerprint()
+        self.record = {
+            "replica": self.replica_id,
+            "label": self.label,
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "started_ts": time.time(),
+            "device_kind": _device_kind(),
+            "versions": versions,
+            "flags": flags,
+            "fingerprint": stack_fingerprint(versions, flags),
+            "withdrawn": False,
+        }
+        self.record.update(fields)
+        self.heartbeat()
+        _events.emit("fleet_announce", replica=self.replica_id,
+                     url=self.record.get("url"),
+                     fingerprint=self.record["fingerprint"],
+                     dir=self.root, label=self.label)
+        if self.heartbeat_s > 0:
+            self._thread = threading.Thread(
+                target=self._beat, daemon=True,
+                name=f"pystella-fleet:{self.replica_id}")
+            self._thread.start()
+        return self
+
+    def heartbeat(self):
+        """One beat: refresh the dynamic fields via ``status_fn`` and
+        rewrite the record atomically."""
+        if self.status_fn is not None:
+            try:
+                self.record.update(self.status_fn() or {})
+            except Exception:  # noqa: BLE001 — never kill serving
+                pass
+        self.heartbeats += 1
+        self._write()
+
+    def _beat(self):
+        while not self._stop.wait(self.heartbeat_s):
+            self.heartbeat()
+
+    def _write(self):
+        rec = dict(self.record)
+        rec["ts"] = time.time()
+        rec["heartbeats"] = self.heartbeats
+        # atomic replace; the tmp name embeds the replica id, so
+        # concurrent writers (distinct replicas) never collide
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, sort_keys=True)
+        os.replace(tmp, self.path)
+
+    def withdraw(self):
+        """Clean exit: stop the heartbeat and write the tombstone
+        (``withdrawn: true``) so readers see a shutdown, not a crash.
+        A no-op after :meth:`kill` — a crashed replica cannot clean
+        up, and the drill relies on that. Idempotent."""
+        self._stop_thread()
+        if self.killed or not self.record:
+            return
+        self.record["withdrawn"] = True
+        self.record["serving"] = False
+        self._write()
+        _events.emit("fleet_withdraw", replica=self.replica_id,
+                     heartbeats=self.heartbeats, label=self.label)
+        self.record = {}
+
+    def kill(self):
+        """Drill seam: simulate a crash — stop beating, leave the
+        record as-is (no tombstone). Readers will watch it go stale
+        and expire it."""
+        self.killed = True
+        self._stop_thread()
+
+    def _stop_thread(self):
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.withdraw()
+
+
+# -- readers ----------------------------------------------------------------
+
+
+def read_records(root, expire_s=None, now=None):
+    """Every parseable record under ``root``, each annotated with
+    ``age_s`` (since last heartbeat) and ``status``: ``"live"``
+    (beating within ``expire_s``), ``"stale"`` (heartbeat expired —
+    presumed crashed), or ``"withdrawn"`` (tombstoned clean exit).
+    Unreadable or non-record files are skipped, not raised — a reader
+    must tolerate a writer mid-crash. ``expire_s`` defaults to the
+    registered ``PYSTELLA_FLEET_EXPIRE_S``."""
+    if expire_s is None:
+        expire_s = _config.get_float("PYSTELLA_FLEET_EXPIRE_S")
+    expire_s = float(expire_s)
+    now = time.time() if now is None else float(now)
+    records = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(root, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict) or not rec.get("replica"):
+            continue
+        ts = rec.get("ts")
+        age = (now - float(ts)) if isinstance(ts, (int, float)) else None
+        rec["age_s"] = age
+        if rec.get("withdrawn"):
+            rec["status"] = "withdrawn"
+        elif age is None or age > expire_s:
+            rec["status"] = "stale"
+        else:
+            rec["status"] = "live"
+        records.append(rec)
+    return records

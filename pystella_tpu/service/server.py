@@ -256,7 +256,8 @@ class ScenarioService:
                  scheduler=None, pool=None, admission=None, store=None,
                  results=None, preempt=None, checkpoint_chunks=2,
                  faults=None, retry=None, planner_factory=None,
-                 cold_policy=None, slo=None, label="service"):
+                 cold_policy=None, slo=None, label="service",
+                 live_port=None, fleet_id=None):
         self.checkpoint_dir = os.path.abspath(str(checkpoint_dir))
         self.slots = int(slots if slots is not None
                          else _config.get_int("PYSTELLA_SERVICE_SLOTS"))
@@ -279,6 +280,13 @@ class ScenarioService:
         self.planner_factory = planner_factory
         self.slo = slo
         self.live_server = None
+        # live_port overrides PYSTELLA_LIVE_PORT for THIS replica: an
+        # int binds that port, "auto" an ephemeral one — two
+        # in-process replicas (the fleet drill) cannot share one env
+        # var. fleet_id likewise pins the registry record identity.
+        self.live_port = live_port
+        self.fleet_id = fleet_id
+        self.fleet_registry = None
         self.label = str(label)
         self._models = {}
         self._arrivals = []          # (due_total_chunks, request)
@@ -476,18 +484,32 @@ class ScenarioService:
         in-process push channel), and start the ``PYSTELLA_LIVE_PORT``
         endpoint. Returns the subscribed-monitor flag for
         :meth:`_live_end`."""
-        port = _config.get_int("PYSTELLA_LIVE_PORT") or 0
-        if port > 0 and self.slo is None:
+        port = self.live_port
+        if port is None:
+            port = _config.get_int("PYSTELLA_LIVE_PORT") or 0
+        enabled = port == "auto" or int(port) > 0
+        if enabled and self.slo is None:
             from pystella_tpu.obs import slo as _slo
             self.slo = _slo.SLOMonitor(label=self.label)
         attached = False
         if self.slo is not None:
             _events.get_log().subscribe(self.slo.handle)
             attached = True
-        if port > 0:
+        if enabled:
             from pystella_tpu.obs import live as _live
             self.live_server = _live.start_from_env(
-                service=self, slo=self.slo, label=self.label)
+                service=self, slo=self.slo, label=self.label,
+                port=port)
+        fleet_dir = _config.getenv("PYSTELLA_FLEET_DIR")
+        if fleet_dir:
+            from pystella_tpu.service import registry as _registry
+            self.fleet_registry = _registry.ReplicaRegistry(
+                fleet_dir, replica_id=self.fleet_id,
+                status_fn=lambda: _registry.service_status_record(self),
+                label=self.label)
+            url = (self.live_server.url()
+                   if self.live_server is not None else None)
+            self.fleet_registry.announce(url=url)
         return attached
 
     def _live_end(self, attached):
@@ -498,6 +520,11 @@ class ScenarioService:
             self.slo.evaluate()
         if attached:
             _events.get_log().unsubscribe(self.slo.handle)
+        if self.fleet_registry is not None:
+            # a no-op after kill(): a "crashed" drill replica must not
+            # tombstone itself on the way out
+            self.fleet_registry.withdraw()
+            self.fleet_registry = None
         if self.live_server is not None:
             self.live_server.close()
             self.live_server = None

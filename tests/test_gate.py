@@ -769,6 +769,38 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     svc_rows = obs_trace.parse_trace_file(svc_trace_path)
     svc_table = obs_trace.scope_durations(svc_rows)
     assert svc_table.get("service_request_span", {}).get("count") == 9
+    # the fleet drill ran end to end: two replicas announced into the
+    # registry and aggregated live (the queue-depth gauge federated
+    # per replica), the seeded fleet burn alert fired AND resolved
+    # from replica-a's deadline story, replica-b's mid-run kill landed
+    # as fleet_replica_lost (heartbeat expiry, not a tombstone), and
+    # the report's fleet section says — honestly — that its coverage
+    # is partial; the gate cases below pin both the annotation and the
+    # refusal of the same record claiming completeness
+    fl = rep["fleet"]
+    assert [r["replica"] for r in fl["replicas"]] \
+        == ["replica-a", "replica-b"]
+    assert fl["replicas_lost"] == [{"replica": "replica-b",
+                                    "reason": "expired",
+                                    "age_s": fl["replicas_lost"][0]
+                                    ["age_s"]}]
+    assert fl["coverage"]["complete"] is False
+    assert fl["coverage"]["lost"] == 1
+    assert fl["endpoint_failed"] == 1
+    assert fl["scrapes"] >= 3
+    fal = fl["alerts"]
+    assert fal["alerts"] == 2 and fal["resolved"] == 1
+    assert [u["leg"] for u in fal["unresolved"]] == ["dead_replicas"]
+    assert fl["legs"]["queue_p95"]["value_fast"] is not None
+    assert fl["skew"]["skewed"] is False and fl["divergence"] == []
+    assert fl["announces"] == 2 and fl["withdraws"] == 1
+    assert "## Fleet (replica registry + federation)" in md
+    fleet_kinds = {r["kind"] for r in events.read_events(
+        os.path.join(out, "smoke_events.jsonl"))}
+    assert {"fleet_announce", "fleet_scrape", "fleet_alert",
+            "fleet_resolved", "fleet_replica_lost", "fleet_withdraw",
+            "fleet_loadgen"} <= fleet_kinds
+    assert "smoke_fleet_failed" not in fleet_kinds
     lint_rep = json.load(open(os.path.join(out, "lint_report.json")))
     spec_stats = lint_rep["graph"]["smoke_spectra"]
     coll = spec_stats["collectives"]
@@ -819,18 +851,18 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     # criterion: cache hit rate >= 0.9 and a strictly lower
     # time-to-first-step, with the warm-start round trip still
     # bit-exact
-    # (--no-ensemble/--no-supervised/--no-spectra/--no-service: those
-    # payloads proved themselves on the cold leg above; rerunning them
-    # would spend tier-1 budget re-verifying the same pipeline. Gating
-    # warm-vs-cold below therefore also covers the lost-ensemble-,
-    # lost-resilience-, lost-fft-, AND lost-service-coverage WARNING
-    # paths: exit stays 0 — and the fft comparison never runs on the
-    # CPU smoke's 4-sample spectra times, which jitter beyond any
-    # honest threshold.)
+    # (--no-ensemble/--no-supervised/--no-spectra/--no-service/
+    # --no-fleet: those payloads proved themselves on the cold leg
+    # above; rerunning them would spend tier-1 budget re-verifying the
+    # same pipeline. Gating warm-vs-cold below therefore also covers
+    # the lost-ensemble-, lost-resilience-, lost-fft-, lost-service-,
+    # AND lost-fleet-coverage WARNING paths: exit stays 0 — and the
+    # fft comparison never runs on the CPU smoke's 4-sample spectra
+    # times, which jitter beyond any honest threshold.)
     out2 = str(tmp_path / "bench_results_warm")
     res2 = run_smoke(out2, "--no-ensemble", "--no-supervised",
                      "--no-spectra", "--no-remesh", "--no-service",
-                     "--no-autotune")
+                     "--no-autotune", "--no-fleet")
     assert res2.returncode == 0, res2.stderr[-2000:]
     warm = json.load(open(os.path.join(out2, "perf_report.json")))
     warm_cs = warm["cold_start"]
@@ -907,6 +939,26 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     assert self_verdict["exit_code"] == 0
     assert self_verdict["degraded"] is True
     assert any("recorded incident" in w for w in self_verdict["warnings"])
+    # ... the fleet half of the same honesty rule: the smoke record's
+    # lost replica is annotated (never refused) while it stays honest
+    assert any("degraded fleet evidence" in w and "replica-b" in w
+               for w in self_verdict["warnings"])
+    # the refusal: the SAME record mutated into a complete-coverage
+    # claim over its own lossy scrapes is invalid evidence, exit 2
+    fake_fleet = json.loads(json.dumps(rep))
+    fake_fleet["fleet"]["coverage"]["complete"] = True
+    fake_verdict = gate.compare_reports(rep, fake_fleet)
+    assert fake_verdict["exit_code"] == 2
+    assert any(r.startswith("invalid_evidence: report claims complete "
+                            "fleet coverage") for r in
+               fake_verdict["reasons"])
+    # --no-fleet opts out of exactly that refusal (argparse -> verdict
+    # path, same as the subprocess runs)
+    fake_fleet_path = str(tmp_path / "fake_fleet.json")
+    json.dump(fake_fleet, open(fake_fleet_path, "w"))
+    assert gate.main(["--baseline", report_path,
+                      "--current", fake_fleet_path, "--no-fleet"]) == 0
+    capsys.readouterr()
 
     # synthetic contamination burst -> invalid evidence (the detector
     # is forced on: auto-mode skips it for CPU reports, where scheduler

@@ -412,6 +412,16 @@ def test_prometheus_label_escaping_and_readiness_probe():
     assert '{tenant="acme\\"corp\\n"}' in text
     assert all(ln.startswith(("#", "pystella_"))
                for ln in text.splitlines() if ln)
+    # the build-info gauge: constant 1, its LABELS are the payload —
+    # the fleet aggregator's skew key reads straight off the exposition
+    info = [ln for ln in text.splitlines()
+            if ln.startswith("pystella_build_info{")]
+    assert len(info) == 1 and info[0].endswith(" 1")
+    labels = live.build_info_labels()
+    assert {"jax", "jaxlib", "libtpu", "flags_fingerprint",
+            "device_kind"} <= set(labels)
+    for key in ("jax", "flags_fingerprint", "device_kind"):
+        assert f'{key}="' in info[0]
 
     class _Idle:
         def live_status(self):
