@@ -63,7 +63,15 @@ costs one leg, not the window):
    gauges, burn-rate state) plus the ledger's ``alerts`` section land
    in the leg record — the first hardware window also validates the
    live plane.
-9. ``cold_start``   — PR 6: the compile-latency leg. Process A dials,
+9. ``perf``         — PR 17: the continuous-performance leg. The
+   seeded ``loadgen.run_perf`` drill (two injected sustained
+   slowdowns) on the held device: ``perf_anomaly`` with straggler
+   attribution, exactly one rate-limited on-hardware ``jax.profiler``
+   flight-recorder artifact, ``perf_recovered``, the
+   ``perf_regression`` SLO fire+resolve, the ledger ``perf`` section
+   linking the capture, and both gate verdicts (honest report passes,
+   doctored unresolved-anomaly copy refused exit-2).
+10. ``cold_start``  — PR 6: the compile-latency leg. Process A dials,
    wires a FRESH ``PYSTELLA_COMPILE_CACHE_DIR``, builds the 512³
    multigrid + preheat step programs cold (recording
    time-to-first-step and the trace/compile split), and AOT-exports
@@ -659,6 +667,65 @@ def worker_service(dry_run):
     return 0 if ok else 1
 
 
+def worker_perf(dry_run):
+    """PR 17: the continuous-performance leg. The seeded
+    ``loadgen.run_perf`` drill on the held device: a StepTimer-driven
+    step loop with two injected sustained slowdowns that must fire
+    ``perf_anomaly`` (with straggler attribution), auto-capture exactly
+    one rate-limited ``jax.profiler`` flight-recorder artifact — a
+    REAL on-hardware trace on a window run — recover
+    (``perf_recovered``), and fire+resolve the ``perf_regression`` SLO
+    leg. The event record then round-trips through the ledger's
+    ``perf`` section and BOTH gate verdicts: the honest report must
+    pass ``check_perf`` and a doctored copy (the anomaly left
+    unresolved) must be refused exit-2 — the full acceptance loop,
+    rehearsable with ``--dry-run``."""
+    import copy
+
+    backend, ndev, dial_s = _dial(dry_run)
+    sys.path.insert(0, REPO)
+    from pystella_tpu.obs import events, gate as obs_gate
+    from pystella_tpu.obs.ledger import PerfLedger
+    from pystella_tpu.service import loadgen
+
+    events.configure(os.path.join(OUT, "run_events.jsonl"))
+    events.emit("run_start", label="tpu-window-perf")
+    capture_dir = os.path.join(OUT, "tpu_window_perf_captures")
+    stats = loadgen.run_perf(capture_dir, label="tpu-window-perf")
+
+    led = PerfLedger.from_events(os.path.join(OUT, "run_events.jsonl"),
+                                 label="tpu-window-perf")
+    rep = led.report()
+    pf = rep.get("perf") or {}
+    # the drill's bimodal sleep schedule IS a contamination signature;
+    # this leg gates the perf-plane machinery, not step-time purity
+    verdict = obs_gate.compare_reports(rep, rep,
+                                       check_contamination="never")
+    doctored = copy.deepcopy(rep)
+    doctored["perf"]["anomalies"]["unresolved"] = [
+        {"leg": "drill", "value": stats["digest"].get("p95_ms"),
+         "bar": stats["digest"].get("p50_ms"), "since_ts": None}]
+    refusal = obs_gate.compare_reports(rep, doctored,
+                                       check_contamination="never")
+    record("perf", backend=backend, ndevices=ndev,
+           dial_s=round(dial_s, 2), drill=stats,
+           ledger_anomalies=(pf.get("anomalies") or {}).get("alerts"),
+           ledger_captures=len(pf.get("captures") or []),
+           ledger_artifact=((pf.get("captures") or [{}])[0]
+                            .get("artifact")),
+           gate_ok=verdict["ok"],
+           doctored_exit=refusal["exit_code"],
+           doctored_refused=(not refusal["ok"]
+                             and refusal["exit_code"] == 2))
+    ok = (stats.get("ok")
+          and (pf.get("anomalies") or {}).get("alerts", 0) >= 2
+          and len(pf.get("captures") or []) == 1
+          and (pf.get("captures") or [{}])[0].get("artifact")
+          and verdict["ok"]
+          and not refusal["ok"] and refusal["exit_code"] == 2)
+    return 0 if ok else 1
+
+
 def worker_autotune(dry_run, phase):
     """phase='sweep': (bx, by, chunk-depth) sweeps at 256^3 and 512^3
     through ops.autotune, winners persisted to
@@ -831,7 +898,7 @@ def main():
     p = argparse.ArgumentParser(prog="tpu_window_validation.py")
     p.add_argument("--legs", default="perf_trace,overlap,lint_tpu,"
                                      "autotune,ensemble,elastic,"
-                                     "remesh,spectral,service,"
+                                     "remesh,spectral,service,perf,"
                                      "cold_start",
                    help="comma-separated legs, priority order")
     p.add_argument("--dry-run", action="store_true",
@@ -850,7 +917,8 @@ def main():
               "elastic": worker_elastic,
               "remesh": worker_remesh,
               "spectral": worker_spectral,
-              "service": worker_service}.get(args.worker)
+              "service": worker_service,
+              "perf": worker_perf}.get(args.worker)
         if fn is not None:
             return fn(args.dry_run)
         if args.worker == "cold_start":

@@ -269,6 +269,58 @@ register("PYSTELLA_SLO_MIN_SAMPLES", default="1", kind="int",
               "exempt — their value IS the sample count); raise it on "
               "a busy service so a single outlier dispatch cannot "
               "page")
+register("PYSTELLA_PERF", default="1", kind="bool",
+         help="continuous-performance plane master switch (obs.perf): "
+              "1 (default) lets StepTimer and the scenario service's "
+              "dispatch loop feed the process-default step-time "
+              "digest + CUSUM change-point detector; 0 disables the "
+              "plane entirely — observe() is a no-op and the default "
+              "monitor is never constructed")
+register("PYSTELLA_PERF_WINDOW", default="64", kind="int",
+         help="healthy-baseline reference window (samples) of the "
+              "continuous-performance CUSUM detector "
+              "(obs.perf.CusumDetector): location/scale are the "
+              "median/MAD over the last this-many healthy samples "
+              "per program signature; the window freezes while an "
+              "anomaly is open so the baseline cannot absorb the "
+              "regression it is reporting")
+register("PYSTELLA_PERF_MIN_SAMPLES", default="16", kind="int",
+         help="samples the reference window must hold before the "
+              "continuous-performance detector may fire — warmup and "
+              "short runs stay quiet")
+register("PYSTELLA_PERF_CUSUM_K", default="0.5", kind="float",
+         help="CUSUM slack in sigmas (obs.perf): a sample only "
+              "accumulates drift when it exceeds baseline + k*sigma; "
+              "also the recovery band — perf_recovered needs the "
+              "recent samples back below that bar")
+register("PYSTELLA_PERF_CUSUM_H", default="8.0", kind="float",
+         help="CUSUM fire threshold in accumulated clipped sigmas "
+              "(obs.perf): per-sample increments are clipped at 4 "
+              "sigma, so with the default 8.0 a single spike cannot "
+              "fire — only >= 2 consecutive far-outliers (or a longer "
+              "run of modest ones) accumulate past it")
+register("PYSTELLA_PERF_RECOVER_N", default="5", kind="int",
+         help="consecutive in-band samples (below baseline + k*sigma) "
+              "after which an open perf anomaly emits perf_recovered "
+              "and the CUSUM accumulator resets")
+register("PYSTELLA_PERF_CAPTURE_DIR", default=None, kind="path",
+         help="artifact root of the anomaly-triggered flight recorder "
+              "(obs.perf.FlightRecorder): when set, a fired "
+              "perf_anomaly starts a rate-limited jax.profiler "
+              "capture of the next PYSTELLA_PERF_CAPTURE_STEPS steps "
+              "and writes the Perfetto trace under this directory "
+              "(perf_capture event carries the path); unset (default) "
+              "disables automatic capture — anomalies still fire, "
+              "nothing is profiled")
+register("PYSTELLA_PERF_CAPTURE_STEPS", default="8", kind="int",
+         help="steps the anomaly-triggered flight recorder keeps the "
+              "profiler running before closing the capture and "
+              "emitting perf_capture")
+register("PYSTELLA_PERF_CAPTURE_COOLDOWN_S", default="600", kind="float",
+         help="minimum seconds between anomaly-triggered profiler "
+              "capture starts — the rate limit: an anomaly storm "
+              "produces at most one trace per cooldown plus a "
+              "suppression count, not a disk full of traces")
 register("PYSTELLA_FLEET_DIR", default=None, kind="path",
          help="shared replica-registry directory of the fleet "
               "observability plane (service.registry / obs.fleet): "

@@ -216,11 +216,26 @@ class _FileChecker(ast.NodeVisitor):
         # event-registry: literal kinds handed to any emit(...) call
         # (obs.events.emit, EventLog.emit, a `log`/`sink` variable —
         # the method NAME is the contract; non-literal first args,
-        # e.g. ResultEmitter.emit(request, ...), are simply not kinds)
-        if attr == "emit" and node.args:
-            lit = _literal_str(node.args[0])
-            if lit is not None:
-                self.emit_literals.setdefault(lit, []).append(node.lineno)
+        # e.g. ResultEmitter.emit(request, ...), are simply not kinds).
+        # A kind= keyword literal counts the same, and so do the
+        # private _emit(kind, ...) wrappers (ops.autotune,
+        # resilience.retry, obs.perf) — both would otherwise drift
+        # past the registry silently. The keyword check is scoped to
+        # emit calls on purpose: kind= elsewhere means something else
+        # entirely (config.register's value type, the SLO monitor's
+        # window statistic).
+        if attr in ("emit", "_emit"):
+            if node.args:
+                lit = _literal_str(node.args[0])
+                if lit is not None:
+                    self.emit_literals.setdefault(
+                        lit, []).append(node.lineno)
+            for kw in node.keywords:
+                if kw.arg == "kind":
+                    lit = _literal_str(kw.value)
+                    if lit is not None:
+                        self.emit_literals.setdefault(
+                            lit, []).append(node.lineno)
 
         # host-sync, strict set: anywhere in a hot module
         if self.hot and isinstance(node.func, ast.Attribute):

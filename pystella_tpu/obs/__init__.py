@@ -94,6 +94,25 @@ persistent service needs scrape-time truth:
   and the gate refuses an unresolved burn alert beside a green post-hoc
   SLO section.
 
+The CONTINUOUS-PERFORMANCE PLANE (PR 17) watches for the regression
+nobody pages on — performance *drift*:
+
+- :mod:`pystella_tpu.obs.perf` — per-program-signature rolling
+  step-time quantile digests (p50/p95/p99, count-vector mergeable
+  across hosts) fed by every :class:`~pystella_tpu.utils.profiling.
+  StepTimer` tick and the scenario service's dispatch loop; a robust
+  CUSUM change-point detector emitting ``perf_anomaly`` /
+  ``perf_recovered`` (routed into the SLO monitor's
+  ``perf_regression`` burn leg); and an anomaly-triggered, rate-limited
+  ``jax.profiler`` flight recorder whose Perfetto artifacts land as
+  ``perf_capture`` events — the evidence is captured while the
+  regression is live, not after an operator notices.
+- :mod:`pystella_tpu.obs.stragglers` — cross-host step-time skew
+  attribution naming the slowest host in every anomaly payload.
+- the ledger gains a ``perf`` report section (anomaly rollup, digest
+  summaries, linked captures) and the gate refuses a report whose
+  unresolved ``perf_anomaly`` sits beside a green step-time verdict.
+
 See ``doc/observability.md`` for the event schema and driver recipes.
 """
 
@@ -118,8 +137,10 @@ from pystella_tpu.obs.memory import (
 # the module is already in sys.modules at -m execution time. Import
 # them explicitly (``from pystella_tpu.obs import gate, spans,
 # warmstart``) for programmatic use.
-from pystella_tpu.obs import forensics, ledger, sentinel, trace
+from pystella_tpu.obs import forensics, ledger, perf, sentinel, stragglers, trace
 from pystella_tpu.obs.ledger import PerfLedger, environment_fingerprint
+from pystella_tpu.obs.perf import (
+    CusumDetector, Digest, FlightRecorder, PerfMonitor)
 from pystella_tpu.obs.trace import scope_durations, summarize_trace
 from pystella_tpu.obs.sentinel import (
     Sentinel, SentinelMonitor, SimulationDiverged)
@@ -138,8 +159,9 @@ __all__ = [
     "cache_bypass", "cache_donation_safe", "probe_cache_donation_safety",
     "program_fingerprint", "signature_fingerprint", "runtime_versions",
     "device_memory_report", "device_memory_stats",
-    "trace", "ledger", "sentinel", "forensics",
+    "trace", "ledger", "sentinel", "forensics", "perf", "stragglers",
     "PerfLedger", "environment_fingerprint",
+    "CusumDetector", "Digest", "FlightRecorder", "PerfMonitor",
     "scope_durations", "summarize_trace",
     "Sentinel", "SentinelMonitor", "SimulationDiverged",
     "ForensicSink", "load_bundle", "write_bundle",

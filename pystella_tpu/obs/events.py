@@ -268,6 +268,20 @@ for _name, _help in (
     ("obs_subscriber_error", "an EventLog emit subscriber raised; the "
                              "emit path degraded it to this one-time "
                              "event instead of breaking"),
+    # -- continuous-performance plane (obs.perf / obs.stragglers) -----------
+    ("perf_digest", "one signature's step-time digest window report "
+                    "(p50/p95/p99 ms + straggler attribution)"),
+    ("perf_anomaly", "the CUSUM change-point detector fired on a "
+                     "sustained step-time shift (signature, baseline, "
+                     "straggler attribution)"),
+    ("perf_recovered", "an anomalous signature's step times returned "
+                       "to the baseline band (duration_s since the "
+                       "matching perf_anomaly)"),
+    ("perf_capture", "an anomaly-triggered flight-recorder profiler "
+                     "capture closed (Perfetto artifact path, "
+                     "rate-limit suppression count)"),
+    ("perf_loadgen", "the seeded continuous-performance drill summary "
+                     "(service.loadgen.run_perf)"),
     # -- fleet observability plane (service.registry / obs.fleet) -----------
     ("fleet_announce", "a serving replica published its registry record "
                        "(replica id, url, stack fingerprint)"),

@@ -45,7 +45,10 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       live burn alert UNRESOLVED at exit while the matching post-hoc
       SLO section claims green (the live and post-hoc halves
       contradict; ``--no-alerts`` opts out, alert-FLAP growth merely
-      warns), the report's ``fleet`` section claims COMPLETE fleet
+      warns), the report's ``perf`` section carries a ``perf_anomaly``
+      UNRESOLVED at exit while the post-hoc step-time verdict claims
+      green (same contradiction for the continuous-performance plane;
+      ``--no-perf`` opts out), the report's ``fleet`` section claims COMPLETE fleet
       coverage while its own scrape record shows lost replicas or
       failed scrapes (fleet aggregates over the survivors are partial
       evidence; an HONESTLY-partial fleet record is annotated
@@ -237,7 +240,7 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     latency_miss_floor=0.05, check_alerts=True,
                     check_fleet=True, fleet_queue_factor=2.5,
                     fleet_queue_floor_s=0.5, fleet_ttfs_factor=2.5,
-                    fleet_ttfs_floor_s=1.0):
+                    fleet_ttfs_floor_s=1.0, check_perf=True):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -328,6 +331,20 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     legs (exit 1); version/flag skew appearing, warm-fingerprint
     divergence, and fleet-alert flap growth warn. ``--no-fleet`` opts
     out.
+
+    ``check_perf`` (default on): the continuous-performance half of
+    the alert-evidence rule, for reports carrying a ``perf`` section
+    (:mod:`pystella_tpu.obs.perf`). A ``perf_anomaly`` still
+    unresolved when the run record ended — the change-point detector
+    watched a sustained step-time shift never recover — beside a GREEN
+    post-hoc step-time verdict is the same live/post-hoc contradiction
+    as an unresolved burn alert: invalid evidence, exit 2
+    (``--no-perf`` opts out). An unresolved anomaly whose post-hoc
+    step verdict also failed is corroboration (warning). Anomalies
+    that fired with NO flight-recorder capture recorded warn (the
+    profiling evidence the plane exists to capture is missing —
+    usually ``PYSTELLA_PERF_CAPTURE_DIR`` unset); anomaly-flap growth
+    and lost perf coverage warn like the other sections.
     """
     verdict = {"ok": True, "exit_code": 0, "reasons": [],
                "warnings": []}
@@ -770,6 +787,8 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
             "lost")
     if check_alerts:
         _check_alerts(verdict, baseline, current)
+    if check_perf:
+        _check_perf(verdict, baseline, current)
     return verdict
 
 
@@ -842,6 +861,78 @@ def _check_alerts(verdict, baseline, current):
     verdict["alerts"] = {
         "alerts": cal.get("alerts"), "resolved": cal.get("resolved"),
         "flaps": c_flaps, "unresolved": len(cal.get("unresolved") or []),
+    }
+
+
+def _check_perf(verdict, baseline, current):
+    """Continuous-performance consistency audit (mutates ``verdict``
+    in place; runs AFTER the step-time comparison because it needs its
+    outcome). The ``perf`` report section
+    (:mod:`pystella_tpu.obs.perf` via the ledger) is the live
+    change-point record of the same step times the post-hoc median
+    comparison gates; the two must agree:
+
+    - an **unresolved-at-exit** ``perf_anomaly`` beside a GREEN
+      post-hoc step-time verdict is a live/post-hoc contradiction —
+      the detector watched a sustained shift never recover while the
+      report claims step times held: invalid evidence, exit 2
+      (``--no-perf`` opts out). Unresolved beside an already-failed
+      step verdict is corroboration (warning).
+    - anomalies that fired with **no flight-recorder capture**
+      recorded warn: the plane's whole point is profiling evidence
+      captured while the regression was live
+      (``PYSTELLA_PERF_CAPTURE_DIR`` probably unset).
+    - **anomaly-flap growth** vs the baseline and lost perf coverage
+      warn like the alert section's equivalents."""
+    cpf = current.get("perf") or {}
+    bpf = (baseline or {}).get("perf") or {}
+    if bpf and not cpf:
+        verdict["warnings"].append(
+            "perf: baseline carried a continuous-performance section "
+            "but the current run has none — change-point coverage was "
+            "lost (PYSTELLA_PERF=0?)")
+        return
+    if not cpf:
+        return
+    can = cpf.get("anomalies") or {}
+    reasons = verdict.get("reasons") or []
+    step_green = not any("median step time" in r for r in reasons)
+    for rec in can.get("unresolved") or []:
+        leg = str(rec.get("leg"))
+        if step_green:
+            verdict.update(ok=False, exit_code=2)
+            verdict["reasons"].append(
+                f"invalid_evidence: perf anomaly {leg!r} was still "
+                f"open when the run record ended ({rec.get('value')} "
+                f"ms vs baseline {rec.get('bar')} ms) but the "
+                "post-hoc step-time verdict claims green — the "
+                "change-point detector and the report contradict; "
+                "trust neither")
+        else:
+            verdict["warnings"].append(
+                f"perf: unresolved anomaly {leg!r} corroborates the "
+                "failed post-hoc step-time verdict")
+    if can.get("alerts") and not cpf.get("captures"):
+        verdict["warnings"].append(
+            f"perf: {can['alerts']} anomaly(ies) fired but no "
+            "flight-recorder capture was recorded — set "
+            "PYSTELLA_PERF_CAPTURE_DIR so the next regression "
+            "profiles itself")
+    b_flaps = (bpf.get("anomalies") or {}).get("flaps")
+    c_flaps = can.get("flaps")
+    if isinstance(b_flaps, int) and isinstance(c_flaps, int) \
+            and c_flaps > b_flaps:
+        verdict["warnings"].append(
+            f"perf: {c_flaps} anomaly flap(s) vs {b_flaps} in the "
+            "baseline — a detector oscillating around its threshold; "
+            "check the report's perf section before trusting either "
+            "verdict")
+    verdict["perf"] = {
+        "anomalies": can.get("alerts"),
+        "recovered": can.get("resolved"),
+        "flaps": c_flaps,
+        "unresolved": len(can.get("unresolved") or []),
+        "captures": len(cpf.get("captures") or []),
     }
 
 
@@ -1390,6 +1481,12 @@ def main(argv=None):
                         "unresolved burn alert beside a green post-hoc "
                         "SLO section refuses the evidence; alert-flap "
                         "growth warns)")
+    p.add_argument("--no-perf", action="store_true",
+                   help="skip the continuous-performance consistency "
+                        "audit (an unresolved perf_anomaly beside a "
+                        "green step-time verdict refuses the "
+                        "evidence; missing flight-recorder captures "
+                        "and anomaly-flap growth warn)")
     p.add_argument("--no-resilience", action="store_true",
                    help="skip the resilience triage (degraded-fleet "
                         "annotation of regressions/contamination across "
@@ -1458,6 +1555,7 @@ def main(argv=None):
         latency_miss_factor=args.latency_miss_factor,
         latency_miss_floor=args.latency_miss_floor,
         check_alerts=not args.no_alerts,
+        check_perf=not args.no_perf,
         check_fleet=not args.no_fleet,
         fleet_queue_factor=args.fleet_queue_factor,
         fleet_queue_floor_s=args.fleet_queue_floor,

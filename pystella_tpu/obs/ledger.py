@@ -285,6 +285,13 @@ class PerfLedger:
         #                                 from the fleet aggregator
         self.fleet_announces = []       # fleet_announce payloads
         self.fleet_withdraws = []       # fleet_withdraw payloads
+        self.perf_events = []           # ("anomaly"|"recovered", ts,
+        #                                 data) from the continuous-
+        #                                 performance detector
+        #                                 (obs.perf) -> perf()
+        self.perf_captures = []         # perf_capture payloads (the
+        #                                 flight-recorder artifacts)
+        self.perf_digests = []          # perf_digest window reports
 
     # -- ingestion ---------------------------------------------------------
 
@@ -500,6 +507,15 @@ class PerfLedger:
                 led.fleet_announces.append(data)
             elif kind == "fleet_withdraw":
                 led.fleet_withdraws.append(data)
+            elif kind == "perf_anomaly":
+                led.perf_events.append(("anomaly", ev.get("ts"), data))
+            elif kind == "perf_recovered":
+                led.perf_events.append(("recovered", ev.get("ts"),
+                                        data))
+            elif kind == "perf_capture":
+                led.perf_captures.append(data)
+            elif kind == "perf_digest":
+                led.perf_digests.append(data)
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -1313,6 +1329,55 @@ class PerfLedger:
             },
         }
 
+    def perf(self):
+        """The continuous-performance summary (:mod:`pystella_tpu.obs.
+        perf` detector + flight recorder): the anomaly rollup per
+        program signature (same shape as :meth:`alerts` — the field
+        the gate audits is ``anomalies.unresolved``, anomalies still
+        open when the run record ends), the latest digest window per
+        signature (p50/p95/p99 ms), the flight-recorder captures with
+        their Perfetto artifact paths (the ledger link the gate checks
+        when anomalies fired), and the straggler attribution from the
+        last anomaly that carried one. ``None`` when the run carried
+        no continuous-performance telemetry at all (``PYSTELLA_PERF=0``
+        or a pre-PR-17 log — coverage the gate warns about when the
+        baseline had it)."""
+        if not (self.perf_events or self.perf_captures
+                or self.perf_digests):
+            return None
+        # reuse the alert rollup: an anomaly is a fired alert on the
+        # leg named by its signature, recovery resolves it
+        anomalies = _alert_rollup([
+            (("alert" if kind == "anomaly" else "resolved"), ts,
+             {**data, "leg": data.get("signature", "step"),
+              "value": data.get("ms"),
+              "bar": data.get("baseline_ms")})
+            for kind, ts, data in self.perf_events])
+        digests = {}
+        for data in self.perf_digests:
+            sig = data.get("signature", "step")
+            digests[sig] = {k: data.get(k) for k in
+                            ("count", "mean_ms", "p50_ms", "p95_ms",
+                             "p99_ms")}
+        straggler = None
+        for kind, _, data in reversed(self.perf_events):
+            if kind == "anomaly" and data.get("straggler"):
+                straggler = data["straggler"]
+                break
+        captures = [{k: data.get(k) for k in
+                     ("signature", "reason", "artifact", "logdir",
+                      "steps", "suppressed", "error") if k in data}
+                    for data in self.perf_captures]
+        return {
+            "anomalies": anomalies,
+            "digests": digests or None,
+            "captures": captures,
+            "captures_suppressed": max(
+                [int(c.get("suppressed") or 0) for c in captures],
+                default=0),
+            "straggler": straggler,
+        }
+
     def latency(self):
         """Request-scoped critical-path latency attribution
         (:mod:`pystella_tpu.obs.spans` over the schema-v2 trace
@@ -1414,6 +1479,7 @@ class PerfLedger:
             "latency": self.latency(),
             "alerts": self.alerts(),
             "fleet": self.fleet(),
+            "perf": self.perf(),
             "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
@@ -1978,6 +2044,48 @@ def render_markdown(rep):
                 f"{_fmt(r.get('total_alert_s'))} s alerting"
                 + (f" (max {_fmt(r.get('max_alert_s'))} s)"
                    if r.get("max_alert_s") is not None else ""))
+        lines.append("")
+    pf = rep.get("perf")
+    if pf:
+        lines += ["## Continuous performance (obs.perf)", ""]
+        an = pf.get("anomalies") or {}
+        lines.append(
+            f"- {_fmt(an.get('alerts'), '.0f', '0')} anomaly(ies) "
+            f"fired, {_fmt(an.get('resolved'), '.0f', '0')} recovered, "
+            f"{_fmt(an.get('flaps'), '.0f', '0')} flap(s)")
+        for rec in an.get("unresolved") or []:
+            lines.append(
+                f"- **UNRESOLVED at exit**: `{rec.get('leg')}` at "
+                f"{_fmt(rec.get('value'))} ms vs baseline "
+                f"{_fmt(rec.get('bar'))} ms — the gate refuses this "
+                "report if its step-time verdict claims green")
+        for sig, d in sorted((pf.get("digests") or {}).items()):
+            lines.append(
+                f"  - `{sig}` digest: p50 {_fmt(d.get('p50_ms'))} / "
+                f"p95 {_fmt(d.get('p95_ms'))} / "
+                f"p99 {_fmt(d.get('p99_ms'))} ms over "
+                f"{_fmt(d.get('count'), '.0f')} step(s)")
+        st = pf.get("straggler")
+        if st:
+            slow = st.get("slowest") or {}
+            lines.append(
+                f"- straggler attribution: host {slow.get('host')} at "
+                f"{_fmt(slow.get('mean_ms'))} ms vs fleet median "
+                f"{_fmt(st.get('median_ms'))} ms "
+                f"(skew {_fmt(st.get('skew'))}"
+                + (", **skewed**)" if st.get("skewed") else ")"))
+        for cap in pf.get("captures") or []:
+            art = cap.get("artifact")
+            lines.append(
+                f"- flight-recorder capture (`{cap.get('signature')}`, "
+                f"{cap.get('steps')} step(s)): "
+                + (f"`{art}`" if art else "no artifact ("
+                   + str(cap.get("error")
+                         or "profiler produced no trace") + ")"))
+        sup = pf.get("captures_suppressed")
+        if sup:
+            lines.append(f"- {sup} capture request(s) rate-limit "
+                         "suppressed (one trace per cooldown)")
         lines.append("")
     fl = rep.get("fleet")
     if fl:

@@ -1,0 +1,672 @@
+"""Continuous-performance plane: step-time digests, change-point
+detection, and anomaly-triggered flight-recorder profiling.
+
+The stack could already prove an SLO was missed (:mod:`obs.slo` burn
+alerts, :mod:`obs.fleet` federation) and autopsy a numerics blowup
+(:mod:`obs.forensics`) — but performance *drift* was invisible: a run
+slowly losing 20% of its headline site-updates/s, or one straggling
+host dragging a pod mesh, produced no event, no alert, no artifact,
+and profiler capture was manual-only, so the evidence was gone by the
+time an operator noticed. This module closes that loop, in four parts:
+
+- :class:`Digest` — a per-program-signature rolling step-time quantile
+  sketch (p50/p95/p99) over geometric histogram bins. The bin-count
+  vector is the merge unit: summing two digests' counts IS the merged
+  digest (associative and commutative by construction), and
+  :func:`merge_across_hosts` gathers the vector through the same
+  :func:`~pystella_tpu.parallel.multihost.all_gather_hosts` path the
+  metrics registry federates over. Quantiles are exported as
+  ``perf.<signature>.p50_ms``/``p95_ms``/``p99_ms`` gauges, so
+  ``/metrics`` and the fleet federation pick them up for free.
+- :class:`CusumDetector` — a robust one-sided CUSUM over each
+  signature's sample series: baseline location/scale from the
+  median/MAD of a healthy reference window (scale floored so a
+  constant series cannot page on its first jitter), per-sample
+  increments clipped so a single spike cannot fire alone — only a
+  SUSTAINED shift accumulates past the threshold. Fires
+  ``perf_anomaly`` (with straggler attribution from
+  :mod:`obs.stragglers` in the payload) and ``perf_recovered`` once
+  the series returns to the baseline band; both are registered kinds,
+  and :class:`~pystella_tpu.obs.slo.SLOMonitor` routes them into its
+  ``perf_regression`` leg — continuous performance gets the standard
+  fast/slow burn-rate treatment and shows up on ``/slo``.
+- straggler attribution — on every anomaly (and every digest window
+  report), the cross-host step-time skew is gathered and the slowest
+  host named in the event payload (:func:`~pystella_tpu.obs.
+  stragglers.attribute`).
+- :class:`FlightRecorder` — on a fired anomaly, a rate-limited
+  ``jax.profiler`` capture of the next N steps, written as a Perfetto
+  artifact and emitted as a ``perf_capture`` event the ledger's
+  ``perf`` section links. At most one capture per cooldown
+  (``PYSTELLA_PERF_CAPTURE_COOLDOWN_S``): an anomaly storm produces
+  one trace and a suppression count, not a disk full of traces.
+
+:class:`~pystella_tpu.utils.profiling.StepTimer` feeds the
+process-default monitor on every tick (``PYSTELLA_PERF=0`` opts out),
+and the scenario service's dispatch loop feeds per-chunk step times
+under the ``service.chunk`` signature — every existing driver becomes
+a detector input with no code changes. The ledger's ``perf`` report
+section rolls the events up post-hoc, and the gate refuses a report
+whose unresolved ``perf_anomaly`` sits beside a green step-time
+verdict (the same live/post-hoc honesty rule as the PR 14 burn
+alerts).
+
+Everything here is telemetry: the observe path is a few float ops and
+two deque appends, capture failures degrade to a recorded error, and
+no code path may take down the step loop it watches.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+
+from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import metrics as _metrics
+from pystella_tpu.obs import stragglers as _stragglers
+
+__all__ = ["Digest", "CusumDetector", "FlightRecorder", "PerfMonitor",
+           "default_monitor", "enabled", "merge_across_hosts",
+           "observe"]
+
+#: geometric bin range of the step-time digest: 1 µs .. 10 min covers a
+#: fused 64³ CPU step through a pod-scale 1024³ window with margin
+DIGEST_LO_MS = 1e-3
+DIGEST_HI_MS = 6e5
+#: bins across that range — relative quantile error is one bin width,
+#: (HI/LO)^(1/bins) - 1 ≈ 4% at 512 bins; the gatherable vector stays
+#: a few KiB
+DIGEST_BINS = 512
+
+#: recent raw samples retained per signature for straggler attribution
+#: (the per-host window mean) and the recovery band check
+_RECENT_SAMPLES = 64
+
+#: once the detector's reference window is full, re-derive median/MAD
+#: only every this many appended samples — a 64-sample rolling median
+#: drifts far slower than that, and the refit's two sorts dominate the
+#: observe() hot path otherwise (the window-filling phase still refits
+#: every sample, so the min_samples boundary behaves exactly)
+_REFIT_EVERY = 8
+
+#: quantile-gauge refresh cadence (samples) — the p50/p95/p99 gauges
+#: are scrape-time telemetry, not the detector input, so paying three
+#: 512-bin scans per step buys nothing; transitions always refresh
+_GAUGE_EVERY = 16
+
+
+class Digest:
+    """A mergeable step-time quantile sketch: counts over geometric
+    bins. ``merge`` sums count vectors, so merging is associative and
+    commutative and a cross-host merge is one
+    ``all_gather_hosts`` + sum (:func:`merge_across_hosts`). Quantile
+    error is bounded by one bin width (~4% relative at the default
+    512 bins over 1 µs..10 min) — plenty for p50/p95/p99 drift
+    detection, where the signal is tens of percent."""
+
+    def __init__(self, lo_ms=DIGEST_LO_MS, hi_ms=DIGEST_HI_MS,
+                 bins=DIGEST_BINS):
+        self.lo_ms = float(lo_ms)
+        self.hi_ms = float(hi_ms)
+        self.bins = int(bins)
+        self._log_lo = math.log(self.lo_ms)
+        self._log_span = math.log(self.hi_ms) - self._log_lo
+        self.counts = [0] * self.bins
+        self.count = 0
+        self.total_ms = 0.0
+
+    def _bin(self, ms):
+        if ms <= self.lo_ms:
+            return 0
+        if ms >= self.hi_ms:
+            return self.bins - 1
+        frac = (math.log(ms) - self._log_lo) / self._log_span
+        return min(self.bins - 1, int(frac * self.bins))
+
+    def _edge(self, i):
+        """Geometric midpoint of bin ``i`` (the quantile estimate)."""
+        frac = (i + 0.5) / self.bins
+        return math.exp(self._log_lo + frac * self._log_span)
+
+    def add(self, ms):
+        ms = float(ms)
+        self.counts[self._bin(ms)] += 1
+        self.count += 1
+        self.total_ms += ms
+
+    def quantile(self, q):
+        """The q-th percentile estimate in ms (``q`` in 0..100), or
+        ``None`` for an empty digest."""
+        if self.count == 0:
+            return None
+        target = max(1, math.ceil(self.count * float(q) / 100.0))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self._edge(i)
+        return self._edge(self.bins - 1)
+
+    def mean(self):
+        return self.total_ms / self.count if self.count else None
+
+    def _compatible(self, other):
+        return (self.bins == other.bins and self.lo_ms == other.lo_ms
+                and self.hi_ms == other.hi_ms)
+
+    def merge(self, other):
+        """A NEW digest holding both inputs' samples (count-vector
+        sum); inputs are untouched, so merges compose freely."""
+        if not self._compatible(other):
+            raise ValueError("cannot merge digests with different "
+                             "bin layouts")
+        out = Digest(self.lo_ms, self.hi_ms, self.bins)
+        out.counts = [a + b for a, b in zip(self.counts, other.counts)]
+        out.count = self.count + other.count
+        out.total_ms = self.total_ms + other.total_ms
+        return out
+
+    @classmethod
+    def from_counts(cls, counts, total_ms=0.0, lo_ms=DIGEST_LO_MS,
+                    hi_ms=DIGEST_HI_MS):
+        """Rebuild a digest from a (possibly host-summed) count
+        vector — the receive side of the federation path."""
+        out = cls(lo_ms, hi_ms, len(counts))
+        out.counts = [int(c) for c in counts]
+        out.count = sum(out.counts)
+        out.total_ms = float(total_ms)
+        return out
+
+    def summary(self):
+        """The JSON-safe window summary the gauges/events carry."""
+        return {
+            "count": self.count,
+            "mean_ms": self.mean(),
+            "p50_ms": self.quantile(50),
+            "p95_ms": self.quantile(95),
+            "p99_ms": self.quantile(99),
+        }
+
+
+def merge_across_hosts(digest):
+    """The fleet-wide digest: gather every host's count vector through
+    :func:`~pystella_tpu.parallel.multihost.all_gather_hosts` and sum.
+    Lockstep contract as with metrics aggregation (SPMD drivers cross
+    their report cadence together); degrades to a copy of the local
+    digest on a single-process run."""
+    import numpy as np
+
+    from pystella_tpu.parallel.multihost import all_gather_hosts
+
+    vec = np.array(digest.counts + [digest.total_ms], dtype=np.float64)
+    gathered = all_gather_hosts(vec)
+    counts = gathered[:, :-1].sum(axis=0)
+    total = float(gathered[:, -1].sum())
+    return Digest.from_counts([int(c) for c in counts], total_ms=total,
+                              lo_ms=digest.lo_ms, hi_ms=digest.hi_ms)
+
+
+class CusumDetector:
+    """Robust one-sided CUSUM change-point detector over one
+    signature's step-time series.
+
+    Location/scale come from the median/MAD of a reference window of
+    HEALTHY samples (the window stops updating while an anomaly is
+    open, so the baseline cannot absorb the regression it is
+    reporting). The scale is floored at ``rel_floor`` of the location:
+    a constant series has MAD 0, and without the floor its first
+    scheduler jitter would page. Per-sample increments are clipped at
+    ``clip`` sigmas, so one spike contributes at most ``clip`` toward
+    the ``h`` threshold — only a sustained shift of at least
+    ``ceil(h / clip)`` consecutive slow samples can fire. Recovery is
+    the last ``recover_n`` samples all back inside the baseline band
+    (below ``mu + k * sigma``), which also resets the accumulator.
+    """
+
+    def __init__(self, window=64, min_samples=16, k=1.0, h=8.0,
+                 clip=4.0, recover_n=6, rel_floor=0.25):
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.k = float(k)
+        self.h = float(h)
+        self.clip = float(clip)
+        self.recover_n = int(recover_n)
+        self.rel_floor = float(rel_floor)
+        self.reference = collections.deque(maxlen=self.window)
+        self.recent = collections.deque(maxlen=max(self.recover_n, 8))
+        self.cusum = 0.0
+        self.anomalous = False
+        self.fired_ts = None
+        self.fires = 0
+        self.recoveries = 0
+        self.mu = None
+        self.sigma = None
+        self._stale = 0     # healthy samples appended since last refit
+
+    def _refit(self):
+        vals = sorted(self.reference)
+        n = len(vals)
+        mid = n // 2
+        mu = vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+        devs = sorted(abs(v - mu) for v in vals)
+        mad = devs[mid] if n % 2 else 0.5 * (devs[mid - 1] + devs[mid])
+        self.mu = mu
+        # 1.4826: Gaussian-consistent MAD->sigma; floored relative to
+        # the location so a near-constant series keeps a usable band
+        self.sigma = max(1.4826 * mad, self.rel_floor * abs(mu), 1e-9)
+
+    def update(self, ms, ts=None):
+        """Feed one sample; returns ``"fired"`` / ``"recovered"`` /
+        ``None``."""
+        ms = float(ms)
+        self.recent.append(ms)
+        if not self.anomalous:
+            self.reference.append(ms)
+            self._stale += 1
+        if len(self.reference) < self.min_samples:
+            return None
+        # refit every sample while the window fills (the baseline moves
+        # fast there); once full, on the _REFIT_EVERY cadence. An open
+        # anomaly appends nothing, so the frozen baseline costs nothing.
+        if self.mu is None or (self._stale
+                               and (len(self.reference) < self.window
+                                    or self._stale >= _REFIT_EVERY)):
+            self._refit()
+            self._stale = 0
+        bar = self.mu + self.k * self.sigma
+        z = (ms - bar) / self.sigma
+        self.cusum = max(0.0, self.cusum
+                         + max(-self.clip, min(self.clip, z)))
+        if not self.anomalous and self.cusum > self.h:
+            self.anomalous = True
+            self.fired_ts = time.time() if ts is None else float(ts)
+            self.fires += 1
+            return "fired"
+        if self.anomalous and len(self.recent) >= self.recover_n \
+                and all(v <= bar
+                        for v in list(self.recent)[-self.recover_n:]):
+            self.anomalous = False
+            self.cusum = 0.0
+            self.recoveries += 1
+            return "recovered"
+        return None
+
+    def state(self):
+        return {
+            "anomalous": self.anomalous,
+            "cusum": round(self.cusum, 6),
+            "threshold": self.h,
+            "baseline_ms": self.mu,
+            "sigma_ms": self.sigma,
+            "fires": self.fires,
+            "recoveries": self.recoveries,
+            "reference_n": len(self.reference),
+        }
+
+
+class _JaxTracer:
+    """The default flight-recorder backend: ``jax.profiler`` around
+    the capture window, artifact located with
+    :func:`~pystella_tpu.obs.trace.find_trace_file`."""
+
+    def start(self, logdir):
+        import os
+
+        import jax
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+
+    def stop(self, logdir):
+        import jax
+        jax.profiler.stop_trace()
+        from pystella_tpu.obs.trace import find_trace_file
+        return find_trace_file(logdir)
+
+
+class FlightRecorder:
+    """Anomaly-triggered, rate-limited profiler capture.
+
+    :meth:`request` arms a capture (unless one is active or the
+    cooldown since the last one has not elapsed — then it only counts
+    the suppression); :meth:`tick` is called once per observed step
+    and closes the capture after ``steps`` of them, emitting one
+    ``perf_capture`` event with the Perfetto artifact path (or
+    ``artifact: null`` plus the error when the profiler produced
+    nothing — capture is best-effort telemetry and never raises into
+    the step loop).
+
+    :arg logdir: capture root; each capture writes under
+        ``<logdir>/<signature>-<n>``. ``None`` disables capturing
+        (requests only count as suppressed-disabled).
+    :arg steps: step-window length per capture.
+    :arg cooldown_s: minimum seconds between capture STARTS — the
+        rate limit. At most one artifact per cooldown, whatever the
+        anomaly rate.
+    :arg tracer: start/stop backend (default ``jax.profiler``); tests
+        inject a stub.
+    :arg clock: monotonic time source (injectable for rate-limit
+        tests).
+    """
+
+    def __init__(self, logdir=None, steps=None, cooldown_s=None,
+                 tracer=None, clock=time.monotonic, label="perf",
+                 log=None):
+        if steps is None:
+            steps = _config.get_int("PYSTELLA_PERF_CAPTURE_STEPS")
+        if cooldown_s is None:
+            cooldown_s = _config.get_float(
+                "PYSTELLA_PERF_CAPTURE_COOLDOWN_S")
+        self.logdir = None if logdir is None else str(logdir)
+        self.steps = max(1, int(steps or 1))
+        self.cooldown_s = float(cooldown_s or 0.0)
+        self.tracer = tracer if tracer is not None else _JaxTracer()
+        self.clock = clock
+        self.label = str(label)
+        self.log = log
+        self.captures = []          # finished-capture payloads, in order
+        self.suppressed = 0         # cooldown-suppressed requests
+        self.errors = 0
+        self._active = None         # (dir, signature, reason, remaining)
+        self._last_start = None
+        self._seq = 0
+
+    def _emit(self, kind, **data):
+        sink = self.log if self.log is not None else _events.get_log()
+        sink.emit(kind, **data)
+
+    def request(self, signature, reason="perf_anomaly"):
+        """Arm a capture for ``signature``; returns True when a
+        capture actually started."""
+        if self.logdir is None or self._active is not None:
+            return False
+        now = self.clock()
+        if self._last_start is not None \
+                and now - self._last_start < self.cooldown_s:
+            self.suppressed += 1
+            return False
+        self._seq += 1
+        import os
+        cap_dir = os.path.join(self.logdir,
+                               f"{signature}-{self._seq}")
+        try:
+            self.tracer.start(cap_dir)
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            self.errors += 1
+            self._emit("perf_capture", signature=signature,
+                       reason=reason, artifact=None, logdir=cap_dir,
+                       steps=0, error=repr(e), label=self.label)
+            return False
+        self._last_start = now
+        self._active = {"dir": cap_dir, "signature": str(signature),
+                        "reason": str(reason),
+                        "remaining": self.steps}
+        return True
+
+    def tick(self):
+        """One observed step passed; closes the active capture when
+        its window is complete."""
+        if self._active is None:
+            return
+        self._active["remaining"] -= 1
+        if self._active["remaining"] <= 0:
+            self.flush()
+
+    def flush(self):
+        """Force-close an active capture (end of run / drill)."""
+        if self._active is None:
+            return
+        active, self._active = self._active, None
+        artifact = None
+        error = None
+        try:
+            artifact = self.tracer.stop(active["dir"])
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            self.errors += 1
+            error = repr(e)
+        payload = {
+            "signature": active["signature"],
+            "reason": active["reason"],
+            "artifact": artifact,
+            "logdir": active["dir"],
+            "steps": self.steps - active["remaining"],
+            "suppressed": self.suppressed,
+            "label": self.label,
+        }
+        if error is not None:
+            payload["error"] = error
+        self.captures.append(payload)
+        self._emit("perf_capture", **payload)
+
+    def state(self):
+        return {
+            "enabled": self.logdir is not None,
+            "captures": len(self.captures),
+            "suppressed": self.suppressed,
+            "errors": self.errors,
+            "active": None if self._active is None
+            else self._active["signature"],
+            "cooldown_s": self.cooldown_s,
+        }
+
+
+class PerfMonitor:
+    """Per-signature step-time digests + change-point detection +
+    flight-recorder triggering — the continuous-performance plane's
+    live half (module docstring).
+
+    :arg window / min_samples / k / h / recover_n: detector knobs
+        (fall back to the registered ``PYSTELLA_PERF_*`` defaults).
+    :arg recorder: a :class:`FlightRecorder`; ``None`` builds one from
+        ``PYSTELLA_PERF_CAPTURE_DIR`` (disabled when that is unset).
+    :arg digest_every: emit a ``perf_digest`` window event every this
+        many samples per signature (0 disables the event; the
+        quantile gauges refresh on the ``_GAUGE_EVERY`` cadence and on
+        every transition regardless).
+    :arg emit: emit ``perf_anomaly``/``perf_recovered`` events on
+        transitions (``False`` keeps the monitor silent for
+        embedding).
+    :arg straggler: include cross-host straggler attribution in
+        anomaly payloads and digest reports (single-host runs degrade
+        to a one-row table).
+    """
+
+    def __init__(self, window=None, min_samples=None, k=None, h=None,
+                 recover_n=None, recorder=None, digest_every=256,
+                 label="perf", emit=True, straggler=True, log=None,
+                 metrics=None):
+        if window is None:
+            window = _config.get_int("PYSTELLA_PERF_WINDOW")
+        if min_samples is None:
+            min_samples = _config.get_int("PYSTELLA_PERF_MIN_SAMPLES")
+        if k is None:
+            k = _config.get_float("PYSTELLA_PERF_CUSUM_K")
+        if h is None:
+            h = _config.get_float("PYSTELLA_PERF_CUSUM_H")
+        if recover_n is None:
+            recover_n = _config.get_int("PYSTELLA_PERF_RECOVER_N")
+        if recorder is None:
+            cap_dir = _config.getenv("PYSTELLA_PERF_CAPTURE_DIR")
+            recorder = FlightRecorder(cap_dir or None, label=label,
+                                      log=log)
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.k = float(k)
+        self.h = float(h)
+        self.recover_n = int(recover_n)
+        self.recorder = recorder
+        self.digest_every = int(digest_every)
+        self.label = str(label)
+        self.emit_events = bool(emit)
+        self.straggler = bool(straggler)
+        self.log = log
+        self._metrics = (metrics if metrics is not None
+                         else _metrics.registry())
+        self._lock = threading.Lock()
+        self._sigs = {}             # signature -> (digest, detector,
+        #                             recent deque)
+        self.observed = 0
+        self.observe_s = 0.0        # cumulative observe() cost — the
+        #                             emit-path overhead, auditable
+
+    def _emit(self, kind, **data):
+        sink = self.log if self.log is not None else _events.get_log()
+        sink.emit(kind, **data)
+
+    def _sig_state(self, signature):
+        st = self._sigs.get(signature)
+        if st is None:
+            st = self._sigs[signature] = {
+                "digest": Digest(),
+                "detector": CusumDetector(
+                    window=self.window,
+                    min_samples=self.min_samples, k=self.k, h=self.h,
+                    recover_n=self.recover_n),
+                "recent": collections.deque(maxlen=_RECENT_SAMPLES),
+            }
+            # pre-register the gauges at NaN so SPMD hosts' snapshot
+            # vectors line up before the first report (metrics.py's
+            # aggregation contract)
+            for q in ("p50", "p95", "p99"):
+                self._metrics.gauge(f"perf.{signature}.{q}_ms")
+            self._metrics.gauge(f"perf.{signature}.anomalous",
+                                reduce="max")
+        return st
+
+    def _attribution(self, recent):
+        if not self.straggler:
+            return None
+        return _stragglers.attribute(list(recent))
+
+    def observe(self, signature, ms, step=None, ts=None):
+        """Feed one step-time sample (milliseconds) for ``signature``.
+        Returns the detector transition (``"fired"`` /
+        ``"recovered"`` / ``None``)."""
+        t0 = time.perf_counter()
+        signature = str(signature)
+        ms = float(ms)
+        with self._lock:
+            st = self._sig_state(signature)
+            st["digest"].add(ms)
+            st["recent"].append(ms)
+            det = st["detector"]
+            change = det.update(ms, ts=ts)
+            count = st["digest"].count
+            # the three 512-bin quantile scans are the observe() hot
+            # path — run them on the gauge cadence, on transitions
+            # (the anomaly payload carries them), and on digest-event
+            # samples, never per step
+            summary = (st["digest"].summary()
+                       if (change is not None
+                           or count % _GAUGE_EVERY == 0
+                           or (self.digest_every
+                               and count % self.digest_every == 0))
+                       else None)
+        if summary is not None:
+            for q in ("p50", "p95", "p99"):
+                v = summary.get(f"{q}_ms")
+                if v is not None:
+                    self._metrics.gauge(
+                        f"perf.{signature}.{q}_ms").set(v)
+        self._metrics.gauge(f"perf.{signature}.anomalous",
+                            reduce="max").set(1.0 if det.anomalous
+                                              else 0.0)
+        if change == "fired":
+            self._metrics.counter("perf.anomalies").inc()
+            straggler = self._attribution(st["recent"])
+            if self.emit_events:
+                self._emit("perf_anomaly", step=step,
+                           signature=signature, ms=ms,
+                           baseline_ms=det.mu, sigma_ms=det.sigma,
+                           cusum=round(det.cusum, 6), threshold=det.h,
+                           straggler=straggler, label=self.label,
+                           **{key: summary[key] for key in
+                              ("p50_ms", "p95_ms", "p99_ms")})
+            self.recorder.request(signature, reason="perf_anomaly")
+        elif change == "recovered":
+            self._metrics.counter("perf.recoveries").inc()
+            if self.emit_events:
+                duration = (time.time() - det.fired_ts
+                            if det.fired_ts else 0.0)
+                self._emit("perf_recovered", step=step,
+                           signature=signature, ms=ms,
+                           baseline_ms=det.mu,
+                           duration_s=round(max(0.0, duration), 6),
+                           label=self.label)
+        self.recorder.tick()
+        if self.digest_every and count % self.digest_every == 0 \
+                and self.emit_events:
+            self._emit("perf_digest", step=step, signature=signature,
+                       straggler=self._attribution(st["recent"]),
+                       label=self.label, **summary)
+        self.observed += 1
+        self.observe_s += time.perf_counter() - t0
+        return change
+
+    def digest(self, signature):
+        """The signature's :class:`Digest` (or ``None``) — the merge
+        unit :func:`merge_across_hosts` federates."""
+        with self._lock:
+            st = self._sigs.get(str(signature))
+            return st["digest"] if st else None
+
+    def state(self):
+        """JSON-safe monitor state: per-signature digest summaries and
+        detector state, recorder bookkeeping, observe-path cost."""
+        with self._lock:
+            sigs = {
+                name: {**st["digest"].summary(),
+                       **st["detector"].state()}
+                for name, st in self._sigs.items()
+            }
+        return {
+            "label": self.label,
+            "signatures": sigs,
+            "anomalous": sorted(n for n, s in sigs.items()
+                                if s["anomalous"]),
+            "recorder": self.recorder.state(),
+            "observed": self.observed,
+            "observe_s": round(self.observe_s, 6),
+        }
+
+
+# -- the process-default monitor (what StepTimer / the service feed) ---------
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def enabled():
+    """The ``PYSTELLA_PERF`` master switch: when off, the default
+    monitor is never constructed and :func:`observe` is a no-op — emit
+    paths are then byte-identical to a build without this plane."""
+    return bool(_config.get_bool("PYSTELLA_PERF"))
+
+
+def default_monitor():
+    """The process-default :class:`PerfMonitor` (constructed lazily
+    from the ``PYSTELLA_PERF_*`` knobs)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = PerfMonitor()
+        return _default
+
+
+def observe(signature, ms, step=None):
+    """Feed one step-time sample into the process-default monitor;
+    no-op when ``PYSTELLA_PERF=0``. The single integration point the
+    drivers use (:class:`~pystella_tpu.utils.profiling.StepTimer`, the
+    scenario service's chunk loop)."""
+    if not enabled():
+        return None
+    return default_monitor().observe(signature, ms, step=step)
+
+
+def _reset_default():
+    """Drop the process-default monitor (tests)."""
+    global _default
+    with _default_lock:
+        _default = None

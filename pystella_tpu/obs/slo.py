@@ -21,6 +21,8 @@ leg                 windowed value (source events)
 ``deadline_miss``   miss fraction over ``member_result`` deadline
                     verdicts (``deadline_missed``)
 ``incident_rate``   count of ``fault_detected`` events in the window
+``perf_regression`` open-anomaly fraction over ``perf_anomaly`` /
+                    ``perf_recovered`` transitions (obs.perf)
 ==================  =====================================================
 
 **Bars.** Each leg's alert bar is built from an *objective* with the
@@ -90,6 +92,12 @@ DEFAULT_LEGS = {
                       "kind": "rate"},
     "incident_rate": {"objective": 0.0, "factor": 1.0, "floor": 0.0,
                       "kind": "count"},
+    # perf_anomaly/perf_recovered land as 1.0/0.0 samples; bar 0.5
+    # means any open anomaly in both windows burns, and the recovery
+    # sample (or age-out) resolves — the deadline_miss pattern applied
+    # to the continuous-performance plane (obs.perf)
+    "perf_regression": {"objective": 0.0, "factor": 2.0, "floor": 0.5,
+                        "kind": "rate"},
 }
 
 #: bounded per-leg sample memory — a monitor on a weeks-lived server
@@ -287,6 +295,10 @@ class SLOMonitor:
                              1.0 if data["deadline_missed"] else 0.0))
         elif kind == "fault_detected":
             hits.append(("incident_rate", 1.0))
+        elif kind == "perf_anomaly":
+            hits.append(("perf_regression", 1.0))
+        elif kind == "perf_recovered":
+            hits.append(("perf_regression", 0.0))
         touched = False
         for name, value in hits:
             leg = self._legs.get(name)

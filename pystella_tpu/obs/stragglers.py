@@ -1,0 +1,83 @@
+"""Cross-host straggler attribution for the continuous-performance plane.
+
+A pod-scale SPMD run is as fast as its slowest host: one machine with a
+thermal throttle, a noisy neighbour, or a failing HBM bank drags every
+collective, and the fleet-wide step time shows the symptom without the
+culprit. This module names the culprit: each host contributes its
+recent-window step-time mean, the vector is gathered through the same
+:func:`~pystella_tpu.parallel.multihost.all_gather_hosts` path the
+metrics registry federates over, and :func:`attribute` reduces it to a
+JSON-safe record — per-host means, the slowest host, and its skew over
+the fleet median — that :class:`~pystella_tpu.obs.perf.PerfMonitor`
+embeds in every ``perf_anomaly`` payload.
+
+On a single-process run the gather degrades to the local vector (one
+host, skew 1.0, never ``skewed``), so the attribution path is exercised
+by every tier-1 drill without a cluster.
+"""
+
+from __future__ import annotations
+
+__all__ = ["attribute", "host_means"]
+
+#: slowest-host mean over fleet-median mean beyond which the record is
+#: flagged ``skewed`` — 1.25x is well past ICI jitter but inside what a
+#: single throttled host does to a lockstep mesh
+DEFAULT_SKEW_FACTOR = 1.25
+
+
+def host_means(window_ms):
+    """Every host's mean of its recent step-time window, as a list of
+    floats indexed by host (jax process index). Gathers through
+    :func:`~pystella_tpu.parallel.multihost.all_gather_hosts` — all
+    hosts must call this in lockstep (the SPMD drivers' window-report
+    cadence does by construction); a single-process run returns its
+    local mean as a one-element list."""
+    import numpy as np
+
+    from pystella_tpu.parallel.multihost import all_gather_hosts
+
+    vals = [float(x) for x in window_ms]
+    mean = sum(vals) / len(vals) if vals else float("nan")
+    gathered = all_gather_hosts(np.array([mean]))
+    return [float(row[0]) for row in gathered]
+
+
+def attribute(window_ms, skew_factor=DEFAULT_SKEW_FACTOR):
+    """The straggler record over this host's recent step-time window
+    (milliseconds): gather every host's window mean and name the
+    slowest one. Returns a JSON-safe dict::
+
+        {"hosts": 4, "mean_ms": [...per host...],
+         "slowest": {"host": 2, "mean_ms": 61.4},
+         "median_ms": 40.1, "skew": 1.53, "skewed": True}
+
+    ``skew`` is the slowest host's mean over the fleet MEDIAN mean (the
+    median, not the mean, so one straggler cannot hide itself by
+    inflating its own reference), ``skewed`` flags it past
+    ``skew_factor``. Returns ``None`` when the window is empty or the
+    gather is unavailable (no jax runtime) — attribution is telemetry
+    and must never take down the step loop."""
+    if not window_ms:
+        return None
+    try:
+        means = host_means(window_ms)
+    except Exception:  # noqa: BLE001 — best-effort telemetry
+        return None
+    if not means:
+        return None
+    slowest = max(range(len(means)), key=lambda i: means[i])
+    ordered = sorted(means)
+    mid = len(ordered) // 2
+    median = (ordered[mid] if len(ordered) % 2
+              else 0.5 * (ordered[mid - 1] + ordered[mid]))
+    skew = means[slowest] / median if median > 0 else 1.0
+    return {
+        "hosts": len(means),
+        "mean_ms": [round(m, 6) for m in means],
+        "slowest": {"host": slowest,
+                    "mean_ms": round(means[slowest], 6)},
+        "median_ms": round(median, 6),
+        "skew": round(skew, 6),
+        "skewed": bool(len(means) > 1 and skew > float(skew_factor)),
+    }

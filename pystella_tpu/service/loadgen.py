@@ -45,6 +45,14 @@ path, the ``fleet_replica_lost`` record, and the unresolved
 ``dead_replicas`` fleet alert are all produced by real machinery in a
 seconds-long run. The ledger's ``fleet`` section and the gate's fleet
 verdicts are pinned against exactly this record in tier-1.
+
+:func:`run_perf` is the continuous-performance counterpart
+(:mod:`pystella_tpu.obs.perf`): a seeded sleep-in-step drill with two
+injected sustained slowdowns that must fire ``perf_anomaly`` (with
+straggler attribution), write exactly one rate-limited flight-recorder
+capture, recover (``perf_recovered``), and fire+resolve the
+``perf_regression`` SLO leg — the tier-1 proof of the whole plane in
+about a second.
 """
 
 from __future__ import annotations
@@ -63,8 +71,9 @@ from pystella_tpu.service.queue import (
 from pystella_tpu.service.results import ResultEmitter
 from pystella_tpu.service.server import ScenarioService
 
-__all__ = ["run", "run_fleet", "build_preheat_model",
-           "seeded_slo_monitor", "seeded_fleet_legs"]
+__all__ = ["run", "run_fleet", "run_perf", "build_preheat_model",
+           "seeded_slo_monitor", "seeded_fleet_legs",
+           "seeded_perf_monitor"]
 
 
 def seeded_slo_monitor(label="loadgen"):
@@ -551,6 +560,128 @@ def run_fleet(workdir, grid=12, nsteps=4, slots=1, chunk=2,
         "wall_s": round(time.perf_counter() - t0, 4),
     }
     _events.emit("fleet_loadgen", **stats)
+    return stats
+
+
+def seeded_perf_monitor(recorder, label="perf-drill"):
+    """The perf drill's deterministic
+    :class:`~pystella_tpu.obs.perf.PerfMonitor` configuration: a short
+    baseline window (16 samples, armed after 8) so a seconds-long
+    drill trains it, ``k=1``/``h=8`` with the standard 4-sigma
+    increment clip — a 5x injected slowdown saturates the clip, so the
+    detector fires on the THIRD consecutive slow step (ceil(8/4)=2
+    full-clip increments plus one more crosses h=8) while a single
+    container-scheduler stall (one clipped increment, then decay)
+    cannot — and six consecutive in-band steps recover it."""
+    from pystella_tpu.obs import perf as _perf
+    return _perf.PerfMonitor(window=16, min_samples=8, k=1.0, h=8.0,
+                             recover_n=6, recorder=recorder,
+                             digest_every=32, label=label)
+
+
+def run_perf(capture_dir, base_ms=5.0, slow_ms=25.0, healthy=30,
+             slow=12, cooldown=20, capture_steps=4, cooldown_s=3600.0,
+             seed=0, label="perf-drill", tracer=None):
+    """The seeded continuous-performance drill: a sleep-in-step loop
+    through a real :class:`~pystella_tpu.utils.profiling.StepTimer`
+    with TWO injected sustained slowdowns, proving the whole plane in
+    about a second of wall time:
+
+    - ``healthy`` steps of ``base_ms`` sleeps train the detector's
+      baseline, then ``slow`` steps of ``slow_ms`` (5x) MUST fire
+      ``perf_anomaly`` — with straggler attribution in the payload —
+      and start the flight recorder, which writes a real
+      ``jax.profiler`` Perfetto artifact over the next
+      ``capture_steps`` steps (``tracer`` overrides the backend for
+      tests);
+    - ``cooldown`` healthy steps recover it (``perf_recovered``);
+    - a SECOND identical slowdown fires again, but the recorder's
+      ``cooldown_s`` rate limit (default: far longer than the drill)
+      suppresses its capture — exactly one artifact per drill, plus a
+      recorded suppression count: the rate-limiting proof;
+    - a seeded :class:`~pystella_tpu.obs.slo.SLOMonitor` rides the
+      run with only the ``perf_regression`` leg, windowed to the last
+      transition sample, so the anomaly fires ``slo_alert`` and the
+      recovery resolves it deterministically.
+
+    The StepTimer emits per-step ``step_time`` events, so the event
+    log ingests into a complete :class:`~pystella_tpu.obs.ledger.
+    PerfLedger` report whose ``perf`` section links the capture — the
+    record the gate's ``check_perf`` audit consumes. Returns the stats
+    dict (also emitted as ``perf_loadgen``), ``stats["ok"]`` rolling
+    up the acceptance pins above."""
+    from pystella_tpu.obs import perf as _perf
+    from pystella_tpu.utils.profiling import StepTimer
+
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(seed)
+    recorder = _perf.FlightRecorder(
+        capture_dir, steps=capture_steps, cooldown_s=cooldown_s,
+        tracer=tracer, label=label)
+    monitor = seeded_perf_monitor(recorder, label=label)
+    slo = _slo.SLOMonitor(legs={
+        "perf_regression": {"window_samples": 1, "min_samples": 1},
+    }, label=label)
+    _events.get_log().subscribe(slo.handle)
+    timer = StepTimer(report_every=1e9, emit_steps=True,
+                      signature="drill", perf=monitor)
+    # the schedule: healthy/slow/healthy/slow/healthy, the jitter
+    # seeded so the healthy phases are not a constant series (the
+    # detector must stay quiet on realistic noise, not on zeros)
+    plan = ([base_ms] * healthy + [slow_ms] * slow
+            + [base_ms] * cooldown + [slow_ms] * slow
+            + [base_ms] * cooldown)
+    try:
+        timer.tick()                      # arms the inter-step clock
+        for ms in plan:
+            time.sleep((ms + float(rng.uniform(0.0, 0.2))) * 1e-3)
+            timer.tick()
+        recorder.flush()                  # close a still-open capture
+        slo.evaluate()
+    finally:
+        _events.get_log().unsubscribe(slo.handle)
+    mstate = monitor.state()
+    det = mstate["signatures"].get("drill") or {}
+    sstate = slo.state()
+    captures = recorder.captures
+    artifact = captures[0].get("artifact") if captures else None
+    straggler = None
+    if det.get("fires"):
+        # re-derive the attribution the anomaly payload carried
+        straggler = monitor._attribution(  # noqa: SLF001 — drill introspection
+            monitor._sigs["drill"]["recent"])
+    stats = {
+        "label": label,
+        "steps": len(plan),
+        "anomalies": int(det.get("fires") or 0),
+        "recovered": int(det.get("recoveries") or 0),
+        "anomalous_at_exit": bool(det.get("anomalous")),
+        "captures": len(captures),
+        "artifact": artifact,
+        "suppressed": recorder.suppressed,
+        "capture_errors": recorder.errors,
+        "straggler": straggler,
+        "digest": {k: det.get(k) for k in
+                   ("count", "p50_ms", "p95_ms", "p99_ms")},
+        "slo": {
+            "alerts": sstate["alerts_total"],
+            "resolved": sstate["resolved_total"],
+            "alerting": sstate["alerting"],
+        },
+        "observe_s": mstate["observe_s"],
+        "wall_s": round(time.perf_counter() - t0, 4),
+    }
+    stats["ok"] = bool(
+        stats["anomalies"] >= 2
+        and stats["recovered"] == stats["anomalies"]
+        and not stats["anomalous_at_exit"]
+        and stats["captures"] == 1
+        and artifact is not None
+        and stats["suppressed"] >= 1
+        and stats["slo"]["alerts"] >= 1
+        and not stats["slo"]["alerting"]
+        and straggler is not None)
+    _events.emit("perf_loadgen", **stats)
     return stats
 
 

@@ -416,6 +416,15 @@ class ScenarioService:
                 steps / (now - self._last_chunk_ts)
             _metrics.gauge("service.member_steps_per_s").set(
                 self.last_chunk_member_steps_per_s)
+            # feed the continuous-performance plane: the per-step wall
+            # time of this chunk, filed under one service-wide
+            # signature so the dispatch loop is a perf_anomaly source
+            # like every StepTimer-owning driver (obs.perf; no-op when
+            # PYSTELLA_PERF=0)
+            from pystella_tpu.obs import perf as _perf
+            _perf.observe(
+                "service.chunk",
+                (now - self._last_chunk_ts) * 1e3 / max(1, lease.chunk))
         self._last_chunk_ts = now
         _metrics.counter("service.chunks").inc()
         self._total_chunks += 1

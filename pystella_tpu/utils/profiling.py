@@ -20,21 +20,34 @@ from pystella_tpu.obs import metrics as _metrics
 __all__ = ["timer", "trace", "StepTimer"]
 
 
-def timer(kernel, ntime=200, nwarmup=2, reps=1):
+def timer(kernel, ntime=200, nwarmup=2, reps=1, min_over_rounds=None):
     """Average milliseconds per call of ``kernel()`` (a thunk returning jax
-    arrays), with warmup; mirrors /root/reference/test/common.py:41-56."""
+    arrays), with warmup; mirrors /root/reference/test/common.py:41-56.
+
+    ``min_over_rounds=R`` (an int > 1) instead runs R such timed rounds
+    and returns the MINIMUM of the per-round averages — the paired
+    min-estimator the autotune sweep persists its winners with
+    (:mod:`pystella_tpu.ops.autotune` takes ``min`` over its
+    interleaved rounds), so an ad-hoc timing and a persisted autotune
+    record report the same statistic: the noise floor, not the
+    scheduler's bad luck."""
     result = None
     for _ in range(nwarmup):
         result = kernel()
     jax.block_until_ready(result)
 
-    start = time.perf_counter()
-    for _ in range(ntime):
-        for _ in range(reps):
-            result = kernel()
-    jax.block_until_ready(result)
-    elapsed = time.perf_counter() - start
-    return elapsed / ntime / reps * 1000
+    rounds = 1 if not min_over_rounds else max(1, int(min_over_rounds))
+    best = None
+    for _ in range(rounds):
+        start = time.perf_counter()
+        for _ in range(ntime):
+            for _ in range(reps):
+                result = kernel()
+        jax.block_until_ready(result)
+        elapsed = time.perf_counter() - start
+        ms = elapsed / ntime / reps * 1000
+        best = ms if best is None else min(best, ms)
+    return best
 
 
 class trace:
@@ -92,16 +105,32 @@ class StepTimer:
     and ``--profile``'d example runs enable it; leave it off for
     million-step production runs where one event per step is too chatty).
 
+    Every tick also feeds the continuous-performance plane
+    (:mod:`pystella_tpu.obs.perf`): the sample lands in the
+    process-default per-signature step-time digest + CUSUM change-point
+    detector, so every driver that owns a StepTimer is a
+    ``perf_anomaly`` source with no code changes. ``PYSTELLA_PERF=0``
+    (or ``perf=False``) opts out.
+
     :arg report_every: seconds between window reports.
     :arg emit_steps: emit a ``step_time`` event on every tick.
     :arg sample_capacity: per-step samples retained in
         :attr:`samples_ms`.
+    :arg signature: program signature the perf digest files samples
+        under (one detector baseline per signature).
+    :arg perf: ``None`` (default) feeds the process-default
+        :class:`~pystella_tpu.obs.perf.PerfMonitor` when
+        ``PYSTELLA_PERF`` is on; ``False`` disables the feed; a
+        :class:`~pystella_tpu.obs.perf.PerfMonitor` instance is used
+        directly (drills).
     """
 
     def __init__(self, report_every=30.0, emit_steps=False,
-                 sample_capacity=4096):
+                 sample_capacity=4096, signature="step", perf=None):
         self.report_every = float(report_every)
         self.emit_steps = bool(emit_steps)
+        self.signature = str(signature)
+        self._perf = perf
         self.samples_ms = collections.deque(maxlen=int(sample_capacity))
         # the clock starts at the FIRST tick, not at construction, so
         # timing covers steps 2..N and excludes the first step's jit
@@ -132,6 +161,14 @@ class StepTimer:
         self.last_tick = now
         self._timer.observe(elapsed)  # the one accumulator
         self.samples_ms.append(elapsed * 1e3)
+        if self._perf is not False:
+            from pystella_tpu.obs import perf as _perf
+            if self._perf is None:
+                _perf.observe(self.signature, elapsed * 1e3,
+                              step=self.steps)
+            else:
+                self._perf.observe(self.signature, elapsed * 1e3,
+                                   step=self.steps)
         if self.emit_steps:
             _events.emit("step_time", step=self.steps, ms=elapsed * 1e3)
         if now - self.last_report < self.report_every:

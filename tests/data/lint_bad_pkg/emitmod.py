@@ -7,3 +7,14 @@ def tattle(step):
     # seeded violation: literal event kind missing from
     # obs.events.registered_event_kinds()
     _events.emit("not_a_registered_event_kind", step=step, note="boom")
+    # seeded violation: same, but handed via the kind= keyword
+    _events.emit(kind="not_a_registered_kw_kind", step=step)
+
+
+class Chatterbox:
+    def _emit(self, kind, **data):
+        _events.emit(kind, **data)
+
+    def blab(self):
+        # seeded violation: unregistered kind through an _emit wrapper
+        self._emit("not_a_registered_wrapped_kind", note="boom")

@@ -72,6 +72,11 @@ def test_source_tier_names_seeded_violations():
     # unregistered event kind handed to emit()
     assert any("not_a_registered_event_kind" in v.message
                for v in by_checker["event-registry"])
+    # ...also via the kind= keyword and an _emit wrapper (PR 17)
+    assert any("not_a_registered_kw_kind" in v.message
+               for v in by_checker["event-registry"])
+    assert any("not_a_registered_wrapped_kind" in v.message
+               for v in by_checker["event-registry"])
 
 
 def test_source_tier_pragma_waives():
@@ -243,6 +248,7 @@ def test_cli_clean_repo():
     assert set(rep["graph"]) == {"step_generic", "step_sentinel",
                                  "fused_multi_step",
                                  "coupled_multi_step", "mg_smooth",
+                                 "chunk_multi_step",
                                  "ensemble_step", "sharded_spectra"}
     assert rep["summary"]["donation"]["coverage_pct"] == 100.0
 
