@@ -948,6 +948,16 @@ def run_smoke(argv=None):
                         "bit-consistent resume; the report's `service` "
                         "section and the gate's SLO verdicts derive "
                         "from it")
+    p.add_argument("--no-capacity", action="store_true",
+                   help="skip the capacity leg riding the service "
+                        "payload: the loadgen's pinned HBM budget, "
+                        "the seeded CapacityExceeded rejection, the "
+                        "per-chunk watermark polls (predicted-only on "
+                        "stat-less backends, honestly flagged), and "
+                        "the retire-time per-tenant chip-second/"
+                        "goodput attribution feeding the report's "
+                        "`capacity` section and the gate's goodput "
+                        "verdicts")
     p.add_argument("--no-fleet", action="store_true",
                    help="skip the two-replica fleet drill: a pair of "
                         "ScenarioService replicas announced into a "
@@ -1479,8 +1489,9 @@ def run_smoke(argv=None):
             from pystella_tpu.service import loadgen as service_loadgen
             svc_ck = os.path.join(args.out, "service_ckpt")
             shutil.rmtree(svc_ck, ignore_errors=True)
-            svc = service_loadgen.run(svc_ck, seed=11,
-                                      label="smoke-service")
+            svc = service_loadgen.run(
+                svc_ck, seed=11, label="smoke-service",
+                capacity=(False if args.no_capacity else None))
             hb(f"smoke: service {svc['completed']}/{svc['requests']} "
                f"request(s) completed over {svc['leases']} lease(s) "
                f"({svc['warm_admissions']} warm / "
@@ -1508,6 +1519,32 @@ def run_smoke(argv=None):
                          preemptions=svc["preemptions"],
                          bitexact=svc["preempt_bitexact"],
                          lease_failures=svc["lease_failures"])
+            cap = svc.get("capacity") or {}
+            if cap:
+                # the capacity leg riding the same loadgen run: the
+                # seeded hog MUST have been refused admission, and
+                # retire-time attribution MUST have produced a goodput
+                # figure (committed member-steps per chip-second) —
+                # the closed loop the report's `capacity` section and
+                # the gate's goodput verdicts consume
+                goodput = svc.get("goodput")
+                hb("smoke: capacity budget "
+                   f"{cap['budget_bytes'] / 2**20:.1f} MiB, hog "
+                   f"rejection={'OK' if cap['hog_rejected'] else 'MISSING'}"
+                   f", {cap['watermark_samples']} watermark sample(s)"
+                   + (" (predicted-only backend)"
+                      if not cap["watermark_samples"] else "")
+                   + (f", goodput {goodput:g} steps/chip-s"
+                      if isinstance(goodput, (int, float)) else ""))
+                if not (cap["hog_rejected"]
+                        and isinstance(goodput, (int, float))
+                        and goodput > 0):
+                    obs.emit("smoke_capacity_failed",
+                             hog_rejected=cap["hog_rejected"],
+                             goodput=goodput,
+                             budget_bytes=cap["budget_bytes"],
+                             watermark_samples=cap[
+                                 "watermark_samples"])
             # the request-scoped trace layer, closed end to end: every
             # loadgen request's span tree reassembles from the event
             # log and exports as a Perfetto-loadable service timeline

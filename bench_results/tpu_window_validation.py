@@ -726,6 +726,102 @@ def worker_perf(dry_run):
     return 0 if ok else 1
 
 
+def worker_capacity(dry_run):
+    """PR 19: the capacity-and-goodput leg. The loadgen mix with the
+    capacity plane armed on the held device: per-fingerprint HBM
+    footprints (aval estimates, upgraded by the AOT sites'
+    ``memory_analysis`` bytes where available), per-chunk live
+    watermarks reconciled against the predictions — on hardware
+    ``device.memory_stats()`` answers; ``--dry-run`` rehearses the
+    honest predicted-only degrade — the seeded CapacityExceeded
+    rejection, and the retire-time per-tenant chip-second/goodput
+    attribution. The record round-trips through the ledger's
+    ``capacity`` section and BOTH gate verdicts: the honest report
+    must pass ``check_capacity``, and a doctored copy claiming
+    complete watermark coverage over zero samples must be refused
+    exit-2 — the full acceptance loop, rehearsable with
+    ``--dry-run``."""
+    import copy
+    import shutil
+
+    backend, ndev, dial_s = _dial(dry_run)
+    sys.path.insert(0, REPO)
+    from pystella_tpu import obs
+    from pystella_tpu.obs import gate as obs_gate
+    from pystella_tpu.obs.ledger import PerfLedger
+    from pystella_tpu.service import loadgen
+
+    events_path = os.path.join(OUT,
+                               "tpu_window_capacity_events.jsonl")
+    obs.configure(events_path)
+    obs.ensure_compilation_cache(
+        os.path.join(OUT, "tpu_window_xla_cache"))
+    obs.emit("run_start", mode="tpu-window-capacity")
+    grid = 16 if dry_run else 256
+    ck = os.path.join(OUT, "tpu_window_capacity_ckpt")
+    shutil.rmtree(ck, ignore_errors=True)
+    t0 = time.perf_counter()
+    stats = loadgen.run(ck, seed=23, grid=grid,
+                        cold_grid=12 if dry_run else 128,
+                        label=f"window-capacity-{grid}^3")
+    wall_s = time.perf_counter() - t0
+
+    # the gate's structural checks refuse any report without step
+    # samples BEFORE the capacity verdicts under test can run; a short
+    # measured step loop rides the same record so the capacity
+    # refusal — not the no-samples refusal — is what the doctored
+    # copy exercises
+    from pystella_tpu.utils.profiling import StepTimer
+    timer = StepTimer(report_every=1e9, emit_steps=True,
+                      signature="capacity-window")
+    timer.tick()
+    for _ in range(40):
+        time.sleep(0.002)
+        timer.tick()
+
+    led = PerfLedger.from_events(events_path,
+                                 label=f"capacity-{grid}^3")
+    rep = led.report()
+    cap = rep.get("capacity") or {}
+    verdict = obs_gate.compare_reports(rep, rep,
+                                       check_contamination="never")
+    doctored = copy.deepcopy(rep)
+    doctored["capacity"]["coverage"] = {
+        "leases": 3, "leases_sampled": 3, "watermark_samples": 0,
+        "predicted_only": False, "complete": True}
+    refusal = obs_gate.compare_reports(rep, doctored,
+                                       check_contamination="never")
+    drill_cap = stats.get("capacity") or {}
+    record("capacity", backend=backend, ndevices=ndev, grid=grid,
+           dial_s=round(dial_s, 2), wall_s=round(wall_s, 2),
+           hog_rejected=drill_cap.get("hog_rejected"),
+           budget_bytes=drill_cap.get("budget_bytes"),
+           watermark_samples=(cap.get("watermarks")
+                              or {}).get("samples"),
+           reconciliation=cap.get("reconciliation"),
+           goodput=cap.get("goodput"),
+           total_chip_s=cap.get("total_chip_s"),
+           tenants=cap.get("tenants"),
+           rejections=(cap.get("rejections") or {}).get("count"),
+           coverage=cap.get("coverage"),
+           gate_ok=verdict["ok"],
+           doctored_exit=refusal["exit_code"],
+           doctored_refused=(not refusal["ok"]
+                             and refusal["exit_code"] == 2))
+    ok = (bool(drill_cap.get("hog_rejected"))
+          and ((cap.get("rejections") or {}).get("count") or 0) >= 1
+          and isinstance(cap.get("goodput"), (int, float))
+          and cap["goodput"] > 0
+          and verdict["ok"]
+          and not refusal["ok"] and refusal["exit_code"] == 2
+          and any("capacity" in r for r in refusal["reasons"])
+          # on hardware the watermark plane must actually sample;
+          # dry-run rehearses the honest predicted-only degrade
+          and (dry_run or ((cap.get("watermarks")
+                            or {}).get("samples") or 0) > 0))
+    return 0 if ok else 1
+
+
 def worker_autotune(dry_run, phase):
     """phase='sweep': (bx, by, chunk-depth) sweeps at 256^3 and 512^3
     through ops.autotune, winners persisted to
@@ -899,7 +995,7 @@ def main():
     p.add_argument("--legs", default="perf_trace,overlap,lint_tpu,"
                                      "autotune,ensemble,elastic,"
                                      "remesh,spectral,service,perf,"
-                                     "cold_start",
+                                     "capacity,cold_start",
                    help="comma-separated legs, priority order")
     p.add_argument("--dry-run", action="store_true",
                    help="CPU + tiny grids: rehearse the plumbing")
@@ -918,7 +1014,8 @@ def main():
               "remesh": worker_remesh,
               "spectral": worker_spectral,
               "service": worker_service,
-              "perf": worker_perf}.get(args.worker)
+              "perf": worker_perf,
+              "capacity": worker_capacity}.get(args.worker)
         if fn is not None:
             return fn(args.dry_run)
         if args.worker == "cold_start":

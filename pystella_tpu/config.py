@@ -414,6 +414,31 @@ register("PYSTELLA_FFT_STENCIL_CROSSOVER", default="1.5", kind="float",
          help="direct-to-FFT flops ratio the auto FFT-stencil policy "
               "requires before taking the k-space path (margin for the "
               "transpose traffic the flops model does not see)")
+register("PYSTELLA_CAPACITY_HEADROOM", default="0.9", kind="float",
+         help="memory-aware admission budget as a fraction of device "
+              "HBM capacity (obs.capacity.CapacityMonitor): resident "
+              "warm-pool programs + the candidate lease's predicted "
+              "footprint must fit capacity x this, else the request is "
+              "rejected with a typed CapacityExceeded verdict")
+register("PYSTELLA_CAPACITY_POLICY", default="reject",
+         help="what memory-aware admission does on overcommit: "
+              "'reject' (default) refuses the request outright "
+              "(capacity_reject event), 'evict' first drops idle "
+              "warm-pool entries not backing queued work "
+              "(capacity_evict events) and re-checks — "
+              "queue-behind-eviction")
+register("PYSTELLA_CAPACITY_BYTES", default=None, kind="int",
+         help="device-capacity override in bytes for the admission "
+              "budget; unset uses the allocator's bytes_limit from "
+              "device.memory_stats(), and where neither exists (CPU) "
+              "the capacity check skips honestly (decision reason "
+              "'no-capacity-limit') instead of guessing")
+register("PYSTELLA_CAPACITY_DIR",
+         help="persistence directory for predicted HBM footprints "
+              "(obs.capacity.FootprintLedger, *.footprint.json beside "
+              "the warm-start artifacts); unset falls back to "
+              "PYSTELLA_WARMSTART_DIR, and with neither set the "
+              "ledger stays in-memory")
 
 # ---------------------------------------------------------------------------
 # driver knobs (bench.py / bench_scaling.py / examples)

@@ -1,0 +1,803 @@
+"""Capacity & goodput plane: HBM footprint ledger, memory-aware
+admission evidence, and per-tenant chip-second attribution.
+
+The obs stack watches speed (:mod:`pystella_tpu.obs.perf`), latency
+(:mod:`pystella_tpu.obs.spans`), and fleet health
+(:mod:`pystella_tpu.obs.fleet`); this module adds the two quantities a
+production service budgets against — **HBM capacity** (will this lease
+OOM the device?) and **goodput** (of every chip-second burned, how many
+became committed member-steps?):
+
+- :class:`FootprintLedger` — per-fingerprint predicted HBM footprints.
+  Predictions come from two sources, kept honest by a ``source`` tag:
+  ``memory_analysis`` when a ``compile`` event carried the backend's
+  byte counts (the AOT path of :func:`~pystella_tpu.obs.memory.
+  compile_with_report`), and ``aval_estimate`` when only the call
+  signature is known (the warm pool's dispatch-path arms — argument
+  bytes from the fingerprint's aval leaves, doubled for the output
+  state). Records persist beside the warm-start artifacts as
+  ``*.footprint.json`` and loading refuses version/flag drift exactly
+  like :meth:`~pystella_tpu.obs.warmstart.WarmstartStore.load`
+  (``capacity_stale`` event + ``None``).
+- :class:`CapacityMonitor` — the service-side runtime: live watermarks
+  polled per chunk from ``device.memory_stats()`` (CPU keeps none, so
+  coverage degrades to ``predicted_only`` with an honest flag rather
+  than inventing numbers), admission-decision bookkeeping for the
+  memory-aware :class:`~pystella_tpu.service.admission.
+  AdmissionController`, an OOM forensic bundle on a RESOURCE_EXHAUSTED
+  lease failure (resident footprint table + watermark series + the
+  admission decision that let it through, via
+  :mod:`pystella_tpu.obs.forensics`), and retire-time **chip-second
+  attribution**: the PR-13 critical-path phases × chips leased roll up
+  into per-tenant, per-request accounts with
+  ``goodput = committed member-steps / total chip-seconds`` (replay
+  and preempt-drain counted as waste).
+
+Everything leaves as registered ``capacity_*`` events plus
+``hbm_bytes_in_use`` / ``hbm_peak_bytes`` / ``goodput`` gauges
+(NaN-preregistered so SPMD snapshot vectors line up; rendered as
+``pystella_hbm_*`` / ``pystella_goodput`` on ``/metrics``, which the
+fleet federation keeps per-replica — a fleet-summed watermark is a
+lie, like queue depth). The ledger's ``capacity`` report section and
+the gate's capacity verdicts (:mod:`pystella_tpu.obs.gate`) consume
+the events; ``python -m pystella_tpu.service usage`` renders the
+chargeback table.
+
+Knobs: ``PYSTELLA_CAPACITY_HEADROOM`` (admission budget fraction of
+device capacity, default 0.9), ``PYSTELLA_CAPACITY_POLICY``
+(``reject`` or ``evict`` — queue-behind-eviction of idle warm
+entries), ``PYSTELLA_CAPACITY_BYTES`` (capacity override where the
+allocator reports no ``bytes_limit``), ``PYSTELLA_CAPACITY_DIR``
+(footprint persistence; defaults to ``PYSTELLA_WARMSTART_DIR``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import re
+import time
+
+from pystella_tpu import config as _config
+from pystella_tpu.obs import events as _events
+from pystella_tpu.obs import memory as _memory
+from pystella_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "FootprintLedger", "CapacityMonitor", "estimate_bytes_from_avals",
+    "resource_exhausted_error", "is_resource_exhausted",
+    "ON_LEASE_PHASES", "WASTE_PHASES",
+]
+
+FOOTPRINT_SCHEMA_VERSION = 1
+
+#: the staleness rule is exactly ``WarmstartStore.load``'s — a
+#: footprint predicted under yesterday's compiler stack does not bound
+#: today's executable
+_STALENESS_KEYS = ("versions", "flags")
+
+#: critical-path phases during which the request actually holds chips
+#: (queue/admission hold none — their seconds appear in the account but
+#: bill zero chip-seconds)
+ON_LEASE_PHASES = (
+    "service_compile",
+    "service_chunk_compute",
+    "service_checkpoint_barrier",
+    "service_recovery_replay",
+    "service_preempt_drain",
+)
+
+#: chip-seconds that bought no committed member-steps
+WASTE_PHASES = ("service_recovery_replay", "service_preempt_drain")
+
+#: event kinds the monitor buffers for retire-time attribution (plus
+#: any span-carrying record, the ledger's own rule)
+_USAGE_KINDS = frozenset((
+    "service_request", "service_admit", "service_dispatch",
+    "service_requeue", "service_reject", "service_lease",
+    "member_result", "deadline_missed",
+))
+
+
+def _safe_label(label):
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", str(label)) or "program"
+
+
+_ITEMSIZE = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+    "complex128": 16,
+}
+
+
+def _dtype_itemsize(name):
+    return _ITEMSIZE.get(str(name), 4)
+
+
+def estimate_bytes_from_avals(avals):
+    """Signature-only footprint estimate from fingerprint aval leaves
+    (``obs.memory._leaf_signature`` rows: ``[shape, dtype, ...]``):
+    argument bytes = Σ prod(shape) × itemsize, and the predicted
+    resident footprint doubles it for the output state (a stepper maps
+    state to state; temporaries unknown without a backend compile).
+    Returns ``(predicted_bytes, breakdown)`` — ``(None, {})`` when no
+    leaf carries a shape."""
+    arg_bytes = 0
+    seen = False
+    for leaf in avals or ():
+        if not isinstance(leaf, (list, tuple)) or not leaf:
+            continue
+        shape = leaf[0]
+        if not isinstance(shape, (list, tuple)):
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        arg_bytes += n * _dtype_itemsize(leaf[1] if len(leaf) > 1
+                                         else "float32")
+        seen = True
+    if not seen:
+        return None, {}
+    breakdown = {"argument_bytes": arg_bytes, "output_bytes": arg_bytes,
+                 "temp_bytes": None, "generated_code_bytes": None}
+    return 2 * arg_bytes, breakdown
+
+
+def predicted_from_compile(data):
+    """Predicted footprint from a ``compile`` event payload carrying
+    the backend's ``memory_analysis()`` byte fields; ``None`` when the
+    payload has none (the dispatch path on stat-less backends)."""
+    parts = [data.get("argument_bytes"), data.get("output_bytes"),
+             data.get("temp_bytes")]
+    if all(not isinstance(p, (int, float)) for p in parts):
+        return None
+    total = sum(int(p) for p in parts if isinstance(p, (int, float)))
+    alias = data.get("alias_bytes")
+    if isinstance(alias, (int, float)):
+        total -= int(alias)
+    gen = data.get("generated_code_bytes")
+    if isinstance(gen, (int, float)):
+        total += int(gen)
+    return max(total, 0)
+
+
+def resource_exhausted_error(detail="injected HBM exhaustion "
+                             "(fault harness)"):
+    """An exception indistinguishable from an allocator OOM as far as
+    classification goes: the real ``XlaRuntimeError`` when jaxlib
+    exposes it, else a local ``RuntimeError`` subclass of the same
+    name; either way the message leads with ``RESOURCE_EXHAUSTED`` —
+    the string the OOM forensic path keys on (mirrors
+    :func:`~pystella_tpu.resilience.faults.device_loss_error`)."""
+    msg = f"RESOURCE_EXHAUSTED: {detail}"
+    try:
+        from jax._src.lib import xla_client
+        return xla_client.XlaRuntimeError(msg)
+    except Exception:
+        cls = type("XlaRuntimeError", (RuntimeError,), {})
+        return cls(msg)
+
+
+def is_resource_exhausted(error):
+    """Does ``error`` look like an allocator OOM? (message-keyed, like
+    ``resilience.retry.classify_exception`` — works on the stand-in
+    class too)."""
+    return "RESOURCE_EXHAUSTED" in str(error)
+
+
+class FootprintLedger:
+    """Per-fingerprint predicted HBM footprints, persisted beside the
+    warm-start artifacts.
+
+    :arg root: persistence directory (created lazily). Default:
+        ``PYSTELLA_CAPACITY_DIR``, falling back to
+        ``PYSTELLA_WARMSTART_DIR``; in-memory only when neither is set.
+
+    A record is ``{schema, label, fingerprint, predicted_bytes,
+    breakdown, source, components, created_ts}``; files are named
+    ``<label>-<fingerprint>.footprint.json``. :meth:`load` refuses
+    version/flag drift against the live process (``capacity_stale``
+    event + ``None``) — the same rule
+    :meth:`~pystella_tpu.obs.warmstart.WarmstartStore.load` enforces,
+    because a footprint predicted for a different compiler stack does
+    not bound what today's compiler schedules."""
+
+    def __init__(self, root=None, log=None):
+        if root is None:
+            root = (_config.getenv("PYSTELLA_CAPACITY_DIR")
+                    or _config.getenv("PYSTELLA_WARMSTART_DIR"))
+        self.root = root
+        self._log = log
+        #: (label, fingerprint) -> record, insertion-ordered
+        self._records = {}
+
+    def _sink(self):
+        return self._log if self._log is not None else _events.get_log()
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, label, fingerprint, predicted_bytes,
+               breakdown=None, source="aval_estimate", components=None,
+               persist=True):
+        """Store (and optionally persist) one footprint; returns the
+        record. A ``memory_analysis`` record is never downgraded by a
+        later ``aval_estimate`` for the same program."""
+        key = (str(label), str(fingerprint))
+        prior = self._records.get(key)
+        if (prior is not None and prior.get("source") == "memory_analysis"
+                and source != "memory_analysis"):
+            return prior
+        rec = {
+            "schema": FOOTPRINT_SCHEMA_VERSION,
+            "label": str(label),
+            "fingerprint": str(fingerprint),
+            "predicted_bytes": (None if predicted_bytes is None
+                                else int(predicted_bytes)),
+            "breakdown": dict(breakdown or {}),
+            "source": str(source),
+            "components": {
+                k: (components or {}).get(k) for k in _STALENESS_KEYS},
+            "created_ts": time.time(),
+        }
+        self._records[key] = rec
+        self._sink().emit("capacity_footprint", label=rec["label"],
+                          fingerprint=rec["fingerprint"],
+                          predicted_bytes=rec["predicted_bytes"],
+                          source=rec["source"], dir=self.root)
+        if persist and self.root:
+            try:
+                os.makedirs(self.root, exist_ok=True)
+                path = os.path.join(
+                    self.root,
+                    f"{_safe_label(label)}-{fingerprint}.footprint.json")
+                with open(path, "w") as f:
+                    json.dump(rec, f, indent=1, sort_keys=True)
+                    f.write("\n")
+            except OSError:
+                pass  # footprint telemetry must never kill an arm
+        return rec
+
+    def record_entry(self, entry, label=None):
+        """Footprint a warm-pool entry from its fingerprint components
+        (aval estimate; no backend query). ``None`` when the entry
+        carries no usable avals."""
+        components = getattr(entry, "components", None) or {}
+        fingerprint = getattr(entry, "fingerprint", None)
+        if fingerprint is None:
+            return None
+        predicted, breakdown = estimate_bytes_from_avals(
+            components.get("avals"))
+        if predicted is None:
+            return None
+        if label is None:
+            label = components.get("label") or getattr(
+                entry, "signature", "program")
+        return self.record(label, fingerprint, predicted, breakdown,
+                           source="aval_estimate", components=components)
+
+    def ingest_compile(self, data):
+        """Upgrade the ledger from a ``compile`` event payload carrying
+        backend byte counts — the AOT sites make predictions exact
+        where an aval estimate stood. No-op without byte fields or a
+        fingerprint."""
+        fingerprint = data.get("fingerprint")
+        predicted = predicted_from_compile(data)
+        if fingerprint is None or predicted is None:
+            return None
+        breakdown = {k: data.get(k) for k in
+                     ("argument_bytes", "output_bytes", "temp_bytes",
+                      "alias_bytes", "generated_code_bytes")}
+        label = data.get("label") or "program"
+        return self.record(label, fingerprint, predicted, breakdown,
+                           source="memory_analysis",
+                           components=_memory.fingerprint_components(label))
+
+    # -- lookup --------------------------------------------------------------
+
+    def get(self, label, fingerprint=None):
+        """Newest in-memory record for ``label`` (exact program when
+        ``fingerprint`` given); ``None`` when unrecorded."""
+        if fingerprint is not None:
+            return self._records.get((str(label), str(fingerprint)))
+        match = None
+        for (lbl, _fp), rec in self._records.items():
+            if lbl == str(label):
+                match = rec
+        return match
+
+    def predicted(self, label, fingerprint=None):
+        rec = self.get(label, fingerprint)
+        return None if rec is None else rec.get("predicted_bytes")
+
+    def entries(self):
+        """All in-memory records, insertion order."""
+        return list(self._records.values())
+
+    # -- persistence ---------------------------------------------------------
+
+    def _disk_metas(self, label=None):
+        if not self.root or not os.path.isdir(self.root):
+            return []
+        metas = []
+        for name in sorted(os.listdir(self.root)):
+            if not name.endswith(".footprint.json"):
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(meta, dict):
+                continue
+            if label is not None and meta.get("label") != str(label):
+                continue
+            metas.append(meta)
+        metas.sort(key=lambda m: m.get("created_ts") or 0.0,
+                   reverse=True)
+        return metas
+
+    def _mismatches(self, meta):
+        """Why the live process cannot trust ``meta``'s prediction:
+        version/flag drift against the live fingerprint components."""
+        live = _memory.fingerprint_components(meta.get("label", ""))
+        saved = meta.get("components") or {}
+        problems = []
+        for key in _STALENESS_KEYS:
+            if saved.get(key) != live.get(key):
+                problems.append(
+                    f"{key}: recorded {saved.get(key)!r} "
+                    f"vs live {live.get(key)!r}")
+        return problems
+
+    def load(self, label, fingerprint=None):
+        """Load the newest persisted footprint for ``label`` that
+        MATCHES the live process (a stale newer record must not shadow
+        an older matching one); ``None`` plus a ``capacity_stale``
+        event when none exists or none matches — the caller then
+        re-estimates cold."""
+        metas = self._disk_metas(label)
+        if fingerprint is not None:
+            metas = [m for m in metas
+                     if m.get("fingerprint") == str(fingerprint)]
+        if not metas:
+            self._sink().emit("capacity_stale", label=str(label),
+                              reason="no footprint", dir=self.root,
+                              fingerprint=fingerprint)
+            return None
+        first_problems = None
+        for meta in metas:
+            problems = self._mismatches(meta)
+            if not problems:
+                key = (meta.get("label"), meta.get("fingerprint"))
+                self._records.setdefault(key, meta)
+                return meta
+            if first_problems is None:
+                first_problems = (meta, problems)
+        meta, problems = first_problems
+        self._sink().emit("capacity_stale", label=str(label),
+                          reason="; ".join(problems),
+                          fingerprint=meta.get("fingerprint"),
+                          candidates=len(metas), dir=self.root)
+        return None
+
+    def table(self):
+        """The forensic/report footprint table: one row per record."""
+        return [{"label": r.get("label"),
+                 "fingerprint": r.get("fingerprint"),
+                 "predicted_bytes": r.get("predicted_bytes"),
+                 "source": r.get("source")}
+                for r in self.entries()]
+
+
+class CapacityMonitor:
+    """Service-side capacity runtime (module docstring): watermarks,
+    admission bookkeeping, the OOM bundle, and retire-time chip-second
+    attribution.
+
+    :arg ledger: a :class:`FootprintLedger` (default-built).
+    :arg headroom: admission budget as a fraction of device capacity
+        (default ``PYSTELLA_CAPACITY_HEADROOM``).
+    :arg capacity_bytes: capacity override (default
+        ``PYSTELLA_CAPACITY_BYTES``; unset → the allocator's
+        ``bytes_limit``; neither → the admission check honestly skips).
+    :arg policy: ``reject`` or ``evict`` (default
+        ``PYSTELLA_CAPACITY_POLICY``).
+
+    :meth:`handle` subscribes to the process event log during a serve
+    loop (the SLO monitor's channel): it buffers the span-carrying
+    records attribution needs and upgrades footprints from byte-bearing
+    ``compile`` events. ``capacity_*`` events it emits itself are
+    filtered out, and the log's re-entrancy guard keeps emits made
+    *from* the callback from echoing back."""
+
+    def __init__(self, ledger=None, headroom=None, capacity_bytes=None,
+                 policy=None, device=None, registry=None, log=None):
+        self.ledger = ledger if ledger is not None else FootprintLedger(
+            log=log)
+        if headroom is None:
+            headroom = _config.get_float("PYSTELLA_CAPACITY_HEADROOM")
+        self.headroom = float(headroom)
+        if capacity_bytes is None:
+            raw = _config.getenv("PYSTELLA_CAPACITY_BYTES")
+            capacity_bytes = int(raw) if raw else None
+        self.capacity_bytes = capacity_bytes
+        if policy is None:
+            policy = _config.getenv("PYSTELLA_CAPACITY_POLICY")
+        if policy not in ("reject", "evict"):
+            raise ValueError(
+                f"capacity policy must be 'reject' or 'evict', "
+                f"got {policy!r}")
+        self.policy = policy
+        self.device = device
+        self._log = log
+        #: signature -> predicted resident footprint record
+        self.resident = {}
+        #: watermark samples, oldest first
+        self.watermarks = []
+        #: lease id -> watermark sample count (coverage)
+        self._lease_samples = {}
+        #: signature -> last admission decision (the OOM bundle's
+        #: "what let it through")
+        self.decisions = {}
+        self.oom_bundles = []
+        self._records = collections.deque(maxlen=65536)
+        metrics = registry if registry is not None else _metrics.registry()
+        self._metrics = metrics
+        # pre-register the gauges at NaN so SPMD hosts' snapshot
+        # vectors line up before the first sample/retire
+        metrics.gauge("hbm_bytes_in_use")
+        metrics.gauge("hbm_peak_bytes", reduce="max")
+        metrics.gauge("goodput")
+
+    def _sink(self):
+        return self._log if self._log is not None else _events.get_log()
+
+    # -- capacity ------------------------------------------------------------
+
+    def capacity_limit(self):
+        """Admittable device bytes: the explicit override, else the
+        allocator's ``bytes_limit``; ``None`` where neither exists
+        (CPU) — the admission check then skips honestly."""
+        if self.capacity_bytes is not None:
+            return int(self.capacity_bytes)
+        stats = _memory.device_memory_stats(self.device)
+        if stats and isinstance(stats.get("bytes_limit"), (int, float)):
+            return int(stats["bytes_limit"])
+        return None
+
+    def resident_bytes(self):
+        """Σ predicted footprint over resident warm-pool programs."""
+        return sum(r.get("predicted_bytes") or 0
+                   for r in self.resident.values())
+
+    def note_armed(self, signature, entry):
+        """Record an armed program's footprint and mark it resident."""
+        rec = self.ledger.record_entry(
+            entry, label=f"service.{signature}")
+        if rec is not None:
+            self.resident[str(signature)] = rec
+        return rec
+
+    def note_evicted(self, signature):
+        self.resident.pop(str(signature), None)
+
+    def admission_check(self, signature, predicted_bytes):
+        """The memory-aware admission verdict input: does ``resident +
+        candidate`` fit ``capacity × headroom``? Returns a decision
+        dict (``admitted``, ``reason``, and the numbers that justify
+        it), remembered per signature for the OOM bundle. Unknown
+        capacity or footprint admits honestly — a guess that rejects
+        real work is worse than an audited skip. An already-armed
+        candidate is excluded from the resident sum (leasing it adds
+        no new program)."""
+        limit = self.capacity_limit()
+        resident = sum(r.get("predicted_bytes") or 0
+                       for sig, r in self.resident.items()
+                       if sig != str(signature))
+        decision = {
+            "signature": str(signature),
+            "predicted_bytes": (None if predicted_bytes is None
+                                else int(predicted_bytes)),
+            "resident_bytes": int(resident),
+            "capacity_bytes": limit,
+            "headroom": self.headroom,
+            "policy": self.policy,
+            "ts": time.time(),
+        }
+        if limit is None:
+            decision.update(admitted=True, reason="no-capacity-limit")
+        elif predicted_bytes is None:
+            decision.update(admitted=True, reason="unknown-footprint")
+        else:
+            budget = limit * self.headroom
+            fits = resident + predicted_bytes <= budget
+            decision.update(
+                admitted=fits,
+                budget_bytes=int(budget),
+                reason="fits" if fits else (
+                    f"resident {resident} + predicted "
+                    f"{int(predicted_bytes)} > budget {int(budget)} "
+                    f"({limit} x {self.headroom})"))
+        self.decisions[str(signature)] = decision
+        return decision
+
+    def candidate_bytes(self, signature, entry=None):
+        """Predicted footprint for an admission candidate: the armed
+        entry's record, else the ledger's newest for the service
+        label (the pre-arm path — e.g. a persisted or pre-seeded
+        footprint), else unknown."""
+        label = f"service.{signature}"
+        if entry is not None and getattr(entry, "fingerprint", None):
+            rec = self.ledger.get(label, entry.fingerprint)
+            if rec is None:
+                rec = self.ledger.record_entry(entry, label=label)
+            if rec is not None:
+                return rec.get("predicted_bytes")
+        rec = self.ledger.get(label)
+        if rec is None:
+            rec = self.ledger.load(label)
+        return None if rec is None else rec.get("predicted_bytes")
+
+    # -- live watermarks -----------------------------------------------------
+
+    def note_lease(self, lease):
+        """Register a lease for coverage accounting (a lease with zero
+        watermark samples must show up as a hole, not vanish)."""
+        self._lease_samples.setdefault(str(lease), 0)
+
+    def poll_watermark(self, lease=None, step=None):
+        """One per-chunk allocator sample: ``bytes_in_use`` /
+        ``peak_bytes_in_use`` into the gauges, the series, and a
+        ``capacity_watermark`` event. Returns the sample, or ``None``
+        on stat-less backends (CPU) — coverage then degrades to
+        ``predicted_only`` instead of lying."""
+        if lease is not None:
+            self.note_lease(lease)
+        stats = _memory.device_memory_stats(self.device)
+        if stats is None:
+            return None
+        sample = {
+            "ts": time.time(),
+            "lease": lease,
+            "step": step,
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            "limit_bytes": stats.get("bytes_limit"),
+        }
+        limit = self.capacity_limit()
+        if limit:
+            used = sample["bytes_in_use"] or 0
+            sample["headroom_frac"] = round(
+                used / (limit * self.headroom), 6)
+        self.watermarks.append(sample)
+        if lease is not None:
+            self._lease_samples[str(lease)] += 1
+        if sample["bytes_in_use"] is not None:
+            self._metrics.gauge("hbm_bytes_in_use").set(
+                sample["bytes_in_use"])
+        if sample["peak_bytes_in_use"] is not None:
+            self._metrics.gauge("hbm_peak_bytes", reduce="max").set(
+                sample["peak_bytes_in_use"])
+        self._sink().emit("capacity_watermark", step=step,
+                          **{k: v for k, v in sample.items()
+                             if k != "ts"})
+        return sample
+
+    # -- event-log subscription ----------------------------------------------
+
+    def handle(self, record):
+        """Event-log subscriber: buffer what attribution needs."""
+        if not isinstance(record, dict):
+            return
+        kind = record.get("kind")
+        if not isinstance(kind, str) or kind.startswith("capacity_"):
+            return
+        if kind == "compile":
+            self.ledger.ingest_compile(record.get("data") or {})
+        if (kind in _USAGE_KINDS or record.get("trace") is not None
+                or record.get("span") is not None):
+            self._records.append(record)
+        if kind == "service_lease":
+            lease = (record.get("data") or {}).get("lease")
+            if lease is not None:
+                self.note_lease(lease)
+
+    # -- live/scrape ---------------------------------------------------------
+
+    def live_fields(self):
+        """Lock-free snapshot for ``live_status``/``/healthz``."""
+        last = self.watermarks[-1] if self.watermarks else {}
+        limit = self.capacity_limit()
+        resident = self.resident_bytes()
+        out = {
+            "capacity_bytes": limit,
+            "headroom": self.headroom,
+            "resident_predicted_bytes": resident,
+            "bytes_in_use": last.get("bytes_in_use"),
+            "peak_bytes_in_use": last.get("peak_bytes_in_use"),
+            "watermark_samples": len(self.watermarks),
+        }
+        if limit:
+            out["headroom_frac"] = round(
+                (last.get("bytes_in_use") or resident)
+                / (limit * self.headroom), 6)
+        return out
+
+    # -- OOM forensics -------------------------------------------------------
+
+    def write_oom_bundle(self, out_dir, error, signature=None,
+                         lease=None, label="service", events_path=None):
+        """The OOM forensic bundle: resident-program footprint table,
+        watermark series, and the admission decision that let the
+        lease through — written via the PR-4 forensics machinery so
+        tooling that reads sentinel bundles reads this too. Returns
+        the bundle path."""
+        from pystella_tpu.obs import forensics as _forensics
+        decision = self.decisions.get(str(signature))
+        path = _forensics.write_bundle(
+            out_dir, step=len(self.watermarks),
+            reason="resource_exhausted",
+            history=self.watermarks[-256:],
+            events_path=events_path,
+            config={
+                "error": str(error),
+                "signature": signature,
+                "lease": lease,
+                "footprints": self.ledger.table(),
+                "resident": sorted(self.resident),
+                "resident_bytes": self.resident_bytes(),
+                "admission": decision,
+                "capacity_bytes": self.capacity_limit(),
+                "headroom": self.headroom,
+                "policy": self.policy,
+            },
+            label=label)
+        self.oom_bundles.append(path)
+        self._sink().emit("capacity_oom", path=path, lease=lease,
+                          signature=signature, label=label,
+                          error=str(error))
+        return path
+
+    # -- chip-second attribution ---------------------------------------------
+
+    def finalize_usage(self, label="service"):
+        """Retire-time attribution over the buffered span stream:
+        assemble the request trees (:mod:`pystella_tpu.obs.spans`),
+        bill each request's on-lease phases × its chip share
+        (``chips / members`` of each lease it rode — co-leased members
+        split the lease's chips, so per-lease bills sum back to
+        ``lease wall × chips``), roll up per tenant, and emit one
+        ``capacity_account`` per request plus one ``capacity_usage``
+        with the tenant table, goodput, reconciliation, and the
+        coverage block the gate audits. Returns the usage dict
+        (``None`` when the stream carries no traced request)."""
+        from pystella_tpu.obs import spans as _spans
+        records = list(self._records)
+        asm = _spans.SpanAssembler.from_records(records)
+        trees = asm.assemble()
+        lease_info = {}
+        for rec in records:
+            if rec.get("kind") != "service_lease":
+                continue
+            data = rec.get("data") or {}
+            span = rec.get("span")
+            if span is not None:
+                lease_info[str(span)] = data
+        accounts = []
+        sink = self._sink()
+        for trace in sorted(trees):
+            tree = trees[trace]
+            shares, chips_list = [], []
+            replayed = 0
+            for span in tree.leases:
+                info = lease_info.get(str(span))
+                if not info:
+                    continue
+                chips = info.get("chips") or 1
+                members = max(int(info.get("requests") or 1), 1)
+                shares.append(chips / members)
+                chips_list.append(int(chips))
+                replayed += int(info.get("replayed_member_steps") or 0)
+            share = (sum(shares) / len(shares)) if shares else 0.0
+            phases = tree.phases or {}
+            on_lease_s = sum(phases.get(p, 0.0) for p in ON_LEASE_PHASES)
+            chip_s = on_lease_s * share
+            waste_s = sum(phases.get(p, 0.0)
+                          for p in WASTE_PHASES) * share
+            steps = 0
+            if tree.status == "completed":
+                result = next(
+                    (rec.get("data") or {} for rec in records
+                     if rec.get("kind") == "member_result"
+                     and rec.get("trace") == trace), {})
+                steps = int(result.get("steps") or 0)
+            account = {
+                "id": tree.request_id,
+                "trace": trace,
+                "tenant": tree.tenant,
+                "signature": tree.signature,
+                "status": tree.status,
+                "chips": max(chips_list) if chips_list else 0,
+                "leases": len(tree.leases),
+                "share": round(share, 6),
+                "queue_s": round(
+                    phases.get("service_queue_wait", 0.0), 6),
+                "chip_s": round(chip_s, 6),
+                "waste_chip_s": round(waste_s, 6),
+                "committed_steps": steps,
+                "replayed_steps": replayed,
+                "goodput": (round(steps / chip_s, 4)
+                            if chip_s > 0 else None),
+                "label": label,
+            }
+            accounts.append(account)
+            sink.emit("capacity_account", **account)
+        if not accounts:
+            return None
+        tenants = {}
+        for a in accounts:
+            row = tenants.setdefault(a["tenant"] or "-", {
+                "requests": 0, "rejected": 0, "chip_s": 0.0,
+                "waste_chip_s": 0.0, "committed_steps": 0})
+            row["requests"] += 1
+            if a["status"] == "rejected":
+                row["rejected"] += 1
+            row["chip_s"] += a["chip_s"]
+            row["waste_chip_s"] += a["waste_chip_s"]
+            row["committed_steps"] += a["committed_steps"]
+        total_chip_s = total_steps = total_waste = 0
+        for row in tenants.values():
+            row["chip_s"] = round(row["chip_s"], 6)
+            row["waste_chip_s"] = round(row["waste_chip_s"], 6)
+            row["goodput"] = (round(
+                row["committed_steps"] / row["chip_s"], 4)
+                if row["chip_s"] > 0 else None)
+            total_chip_s += row["chip_s"]
+            total_steps += row["committed_steps"]
+            total_waste += row["waste_chip_s"]
+        goodput = (round(total_steps / total_chip_s, 4)
+                   if total_chip_s > 0 else None)
+        if goodput is not None and math.isfinite(goodput):
+            self._metrics.gauge("goodput").set(goodput)
+        leases = len(self._lease_samples)
+        sampled = sum(1 for n in self._lease_samples.values() if n > 0)
+        samples = len(self.watermarks)
+        coverage = {
+            "leases": leases,
+            "leases_sampled": sampled,
+            "watermark_samples": samples,
+            "predicted_only": samples == 0,
+            "complete": leases > 0 and sampled == leases,
+        }
+        reconciliation = None
+        peaks = [w.get("peak_bytes_in_use") for w in self.watermarks
+                 if isinstance(w.get("peak_bytes_in_use"), (int, float))]
+        if peaks:
+            predicted = self.resident_bytes()
+            peak = max(peaks)
+            reconciliation = {
+                "predicted_bytes": int(predicted),
+                "peak_bytes_in_use": int(peak),
+                "rel_err": round(
+                    abs(predicted - peak) / max(peak, 1), 4),
+            }
+        usage = {
+            "label": label,
+            "requests": len(accounts),
+            "total_chip_s": round(total_chip_s, 6),
+            "committed_steps": int(total_steps),
+            "waste_chip_s": round(total_waste, 6),
+            "goodput": goodput,
+            "tenants": tenants,
+            "coverage": coverage,
+            "reconciliation": reconciliation,
+            "capacity_bytes": self.capacity_limit(),
+            "headroom": self.headroom,
+            "resident_predicted_bytes": self.resident_bytes(),
+        }
+        sink.emit("capacity_usage", **usage)
+        return usage

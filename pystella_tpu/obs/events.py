@@ -299,6 +299,31 @@ for _name, _help in (
                        "fleet_alert)"),
     ("fleet_loadgen", "the two-replica fleet drill summary "
                       "(service.loadgen.run_fleet)"),
+    # -- capacity & goodput plane (obs.capacity) ----------------------------
+    ("capacity_footprint", "a program's predicted HBM footprint "
+                           "recorded (fingerprint, bytes, source: "
+                           "memory_analysis or aval_estimate)"),
+    ("capacity_stale", "a persisted footprint was refused "
+                       "(version/flag drift — the warmstart staleness "
+                       "rule) or none existed"),
+    ("capacity_watermark", "one per-chunk live allocator sample "
+                           "(bytes_in_use / peak_bytes_in_use / "
+                           "headroom fraction)"),
+    ("capacity_reject", "memory-aware admission refused a request: "
+                        "resident + predicted footprint exceeded "
+                        "capacity x headroom (CapacityExceeded)"),
+    ("capacity_evict", "the evict admission policy dropped an idle "
+                       "warm-pool entry to make room for a candidate "
+                       "lease"),
+    ("capacity_oom", "a RESOURCE_EXHAUSTED lease failure wrote an OOM "
+                     "forensic bundle (footprint table, watermark "
+                     "series, the admitting decision)"),
+    ("capacity_account", "one request's retire-time chip-second "
+                         "account (phases x chip share, committed "
+                         "steps, waste, goodput)"),
+    ("capacity_usage", "the serve loop's capacity/goodput rollup "
+                       "(per-tenant chargeback table, reconciliation, "
+                       "watermark coverage)"),
     # -- driver-side kinds (bench.py / examples; outside the package, so
     # -- not lint-audited, but registered so the vocabulary is one list)
     ("bench_run", "bench payload run metadata"),
@@ -316,6 +341,8 @@ for _name, _help in (
     ("smoke_remesh_failed", "smoke: remesh drill failed"),
     ("smoke_service_failed", "smoke: service payload failed"),
     ("smoke_fleet_failed", "smoke: two-replica fleet drill failed"),
+    ("smoke_capacity_failed", "smoke: capacity/goodput leg failed its "
+                              "pins"),
 ):
     register_event_kind(_name, _help)
 del _name, _help

@@ -240,7 +240,9 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     latency_miss_floor=0.05, check_alerts=True,
                     check_fleet=True, fleet_queue_factor=2.5,
                     fleet_queue_floor_s=0.5, fleet_ttfs_factor=2.5,
-                    fleet_ttfs_floor_s=1.0, check_perf=True):
+                    fleet_ttfs_floor_s=1.0, check_perf=True,
+                    check_capacity=True, goodput_factor=2.0,
+                    goodput_floor=1.0, reconciliation_warn_pct=25.0):
     """Pure comparison core (the CLI is a thin wrapper; tests drive
     this). Returns a verdict dict with ``exit_code``.
 
@@ -345,6 +347,26 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     profiling evidence the plane exists to capture is missing —
     usually ``PYSTELLA_PERF_CAPTURE_DIR`` unset); anomaly-flap growth
     and lost perf coverage warn like the other sections.
+
+    ``check_capacity`` (default on): the capacity-and-goodput half of
+    the evidence rule, for reports carrying a ``capacity`` section
+    (:mod:`pystella_tpu.obs.capacity`). A report whose capacity
+    coverage block claims ``complete`` watermark coverage while
+    recording ZERO live watermark samples is refused (exit 2) — a
+    full-coverage reconciliation claim with no device readings behind
+    it proves nothing. The honest version (coverage says
+    ``predicted_only``, the CPU degrade) is annotated
+    (``verdict["degraded"]`` + warning), never silently accepted, and
+    a predicted-vs-measured reconciliation error beyond
+    ``reconciliation_warn_pct`` warns (the footprint model is
+    drifting from the device). Against a baseline, **goodput**
+    (committed member-steps per chip-second) regresses DOWNWARD: the
+    gate fails (exit 1) when current goodput drops below baseline /
+    ``goodput_factor`` AND by more than ``goodput_floor``
+    steps/chip-s absolute — the factor+floor shape of every other SLO
+    leg, with the inequality flipped because higher is better. Waste
+    chip-second growth (replay + preempt-drain share) and lost
+    capacity coverage warn. ``--no-capacity`` opts out.
     """
     verdict = {"ok": True, "exit_code": 0, "reasons": [],
                "warnings": []}
@@ -560,6 +582,45 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                 f"{cfl.get('scrape_success_rate')} — fleet legs "
                 "aggregate the survivors; see the report's fleet "
                 "section before trusting fleet-wide claims")
+
+    if check_capacity:
+        ccap = current.get("capacity") or {}
+        ccov = ccap.get("coverage") or {}
+        n_samples = ccov.get("watermark_samples")
+        if ccap and ccov.get("complete") and not n_samples:
+            # the report CLAIMS its footprint reconciliation covered
+            # every lease with live watermarks while recording zero
+            # device samples: the "measured" side of the ledger never
+            # existed, so the reconciliation (and any OOM headroom
+            # claim built on it) proves nothing either way
+            verdict.update(ok=False, exit_code=2)
+            verdict["reasons"].append(
+                "invalid_evidence: report claims complete capacity "
+                "coverage but records 0 live watermark sample(s) — "
+                "a predicted-vs-measured reconciliation with no "
+                "device readings is not evidence of headroom")
+            return verdict
+        if ccap and ccov.get("predicted_only"):
+            # the honest CPU degrade: no device.memory_stats() on
+            # this host, so the ledger carries predictions only —
+            # annotated, never silently accepted as measured headroom
+            verdict["degraded"] = True
+            verdict["warnings"].append(
+                "capacity: predicted-only footprint evidence (no "
+                "live watermark samples on this host) — HBM "
+                "headroom claims rest on the aval/memory-analysis "
+                "model, not device readings")
+        rec = ccap.get("reconciliation") or {}
+        rel = rec.get("rel_err")
+        if isinstance(rel, (int, float)) \
+                and abs(rel) > reconciliation_warn_pct / 100.0:
+            verdict["warnings"].append(
+                "capacity: predicted footprints disagree with the "
+                f"measured HBM peak by {abs(rel):.0%} (warn bar "
+                f"{reconciliation_warn_pct:g}%) — the footprint "
+                "model is drifting from the device; re-arm with "
+                "fresh compile records before trusting admission "
+                "decisions")
 
     if check_latency:
         clat = current.get("latency") or {}
@@ -779,6 +840,10 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                        queue_floor_s=fleet_queue_floor_s,
                        ttfs_factor=fleet_ttfs_factor,
                        ttfs_floor_s=fleet_ttfs_floor_s)
+    if check_capacity:
+        _compare_capacity(verdict, baseline, current,
+                          goodput_factor=goodput_factor,
+                          goodput_floor=goodput_floor)
     if check_resilience and (baseline or {}).get("resilience") \
             and not current.get("resilience"):
         verdict["warnings"].append(
@@ -1148,6 +1213,68 @@ def _compare_fleet(verdict, baseline, current, queue_factor=2.5,
         verdict["fleet"] = compared
 
 
+def _compare_capacity(verdict, baseline, current, goodput_factor=2.0,
+                      goodput_floor=1.0):
+    """Goodput comparison (mutates ``verdict`` in place): the current
+    ``capacity.goodput`` — committed member-steps per chip-second
+    leased (:mod:`pystella_tpu.obs.capacity` attribution over the
+    span phases × chips) — held to the same factor+floor shape as the
+    service SLO legs, with the inequality FLIPPED: goodput regresses
+    downward, so the gate fails (exit 1) when current drops below
+    baseline / ``goodput_factor`` AND by more than ``goodput_floor``
+    steps/chip-s absolute. Waste chip-second growth (replay +
+    preempt-drain share of the leased chip time) warns against the
+    baseline, and coverage loss (baseline had a capacity section,
+    current does not) degrades to a warning. The partial-evidence
+    refusal and the predicted-only annotation run earlier, before any
+    baseline is consulted."""
+    bcap = (baseline or {}).get("capacity") or {}
+    ccap = current.get("capacity") or {}
+    if bcap and not ccap:
+        verdict["warnings"].append(
+            "capacity: baseline carried a capacity section but the "
+            "current run has none — HBM-footprint/goodput coverage "
+            "was lost")
+        return
+    if not ccap or not bcap:
+        return
+    b = bcap.get("goodput")
+    c = ccap.get("goodput")
+    if isinstance(b, (int, float)) and b > 0 \
+            and isinstance(c, (int, float)):
+        verdict["capacity"] = {
+            "baseline_goodput": b, "current_goodput": c,
+            "factor": goodput_factor, "floor": goodput_floor}
+        if c < b / goodput_factor and b - c > goodput_floor:
+            verdict.update(ok=False,
+                           exit_code=max(verdict["exit_code"], 1))
+            verdict["reasons"].append(
+                f"goodput regression: {c:.3g} committed "
+                f"steps/chip-s vs baseline {b:.3g} (allowed factor "
+                f"{goodput_factor:g}, floor {goodput_floor:g}) — "
+                "chips are burning on waste (replay, drain, idle "
+                "leases); see the report's capacity section")
+        elif c > b * goodput_factor and c - b > goodput_floor:
+            verdict["warnings"].append(
+                f"goodput improvement: {c:.3g} steps/chip-s vs "
+                f"baseline {b:.3g} — consider refreshing the "
+                "baseline")
+    elif isinstance(b, (int, float)) and c is None:
+        verdict["warnings"].append(
+            "capacity: baseline tracked goodput but the current "
+            "run's capacity section carries none — chip-second "
+            "attribution coverage was lost")
+    b_waste = bcap.get("waste_chip_s")
+    c_waste = ccap.get("waste_chip_s")
+    if isinstance(b_waste, (int, float)) \
+            and isinstance(c_waste, (int, float)) \
+            and c_waste > 2.0 * b_waste and c_waste - b_waste > 1.0:
+        verdict["warnings"].append(
+            f"capacity: {c_waste:.3g} waste chip-second(s) (replay + "
+            f"preempt-drain) vs {b_waste:.3g} in the baseline — "
+            "recovery/eviction churn is eating leased chip time")
+
+
 def _compare_latency(verdict, baseline, current, miss_factor=2.0,
                      miss_floor=0.05):
     """Deadline-miss SLO comparison (mutates ``verdict`` in place):
@@ -1476,6 +1603,19 @@ def main(argv=None):
                         "over-lossy-scrapes refusal, degraded-fleet "
                         "annotation, fleet queue-p95/warm-TTFS "
                         "regressions, skew/divergence/flap warnings)")
+    p.add_argument("--goodput-factor", type=float, default=2.0,
+                   help="capacity: allowed divisor of the baseline's "
+                        "goodput (committed steps/chip-s) before the "
+                        "gate fails (default 2)")
+    p.add_argument("--goodput-floor", type=float, default=1.0,
+                   help="capacity: absolute steps/chip-s a goodput "
+                        "regression must also exceed (default 1)")
+    p.add_argument("--no-capacity", action="store_true",
+                   help="skip the capacity checks (complete-coverage-"
+                        "with-no-watermarks refusal, predicted-only "
+                        "annotation, reconciliation-drift warning, "
+                        "goodput regression, waste-chip-second "
+                        "growth)")
     p.add_argument("--no-alerts", action="store_true",
                    help="skip the live-alert consistency audit (an "
                         "unresolved burn alert beside a green post-hoc "
@@ -1560,7 +1700,10 @@ def main(argv=None):
         fleet_queue_factor=args.fleet_queue_factor,
         fleet_queue_floor_s=args.fleet_queue_floor,
         fleet_ttfs_factor=args.fleet_ttfs_factor,
-        fleet_ttfs_floor_s=args.fleet_ttfs_floor)
+        fleet_ttfs_floor_s=args.fleet_ttfs_floor,
+        check_capacity=not args.no_capacity,
+        goodput_factor=args.goodput_factor,
+        goodput_floor=args.goodput_floor)
 
     print(json.dumps(verdict, indent=1, sort_keys=True))
     for w in verdict.get("warnings", []):

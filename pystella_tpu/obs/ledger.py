@@ -292,6 +292,14 @@ class PerfLedger:
         self.perf_captures = []         # perf_capture payloads (the
         #                                 flight-recorder artifacts)
         self.perf_digests = []          # perf_digest window reports
+        self.capacity_footprints = []   # capacity_footprint payloads
+        self.capacity_watermarks = []   # capacity_watermark samples
+        self.capacity_rejects = []      # capacity_reject payloads
+        self.capacity_evictions = []    # capacity_evict payloads
+        self.capacity_oom = []          # capacity_oom payloads (the
+        #                                 OOM forensic-bundle pointers)
+        self.capacity_accounts = []     # capacity_account payloads
+        self.capacity_usage = {}        # last capacity_usage payload
 
     # -- ingestion ---------------------------------------------------------
 
@@ -516,6 +524,20 @@ class PerfLedger:
                 led.perf_captures.append(data)
             elif kind == "perf_digest":
                 led.perf_digests.append(data)
+            elif kind == "capacity_footprint":
+                led.capacity_footprints.append(data)
+            elif kind == "capacity_watermark":
+                led.capacity_watermarks.append(data)
+            elif kind == "capacity_reject":
+                led.capacity_rejects.append(data)
+            elif kind == "capacity_evict":
+                led.capacity_evictions.append(data)
+            elif kind == "capacity_oom":
+                led.capacity_oom.append(data)
+            elif kind == "capacity_account":
+                led.capacity_accounts.append(data)
+            elif kind == "capacity_usage":
+                led.capacity_usage = data
             elif kind in ("run_start", "bench_run"):
                 led.meta = data
         if not led.samples_ms and window_ms:
@@ -1378,6 +1400,82 @@ class PerfLedger:
             "straggler": straggler,
         }
 
+    def capacity(self):
+        """The capacity & goodput summary (:mod:`pystella_tpu.obs.
+        capacity`): the per-program footprint table (predicted bytes +
+        prediction source) against the observed live watermarks, the
+        predicted-vs-peak reconciliation, the headroom series summary,
+        memory-aware admission rejections/evictions, OOM forensic
+        bundles, and the retire-time chargeback — per-tenant
+        chip-second/goodput table plus the overall
+        ``goodput = committed member-steps / total chip-seconds``. The
+        ``coverage`` block is the gate's honesty anchor: a capacity
+        claim over leases with NO watermark samples cannot read as
+        ``complete`` (CPU runs degrade to ``predicted_only``). ``None``
+        when the run carried no capacity telemetry at all (pre-PR-19
+        logs, or the plane disabled)."""
+        if not (self.capacity_footprints or self.capacity_watermarks
+                or self.capacity_accounts or self.capacity_usage
+                or self.capacity_rejects or self.capacity_oom):
+            return None
+        usage = self.capacity_usage or {}
+        footprints = {}
+        for data in self.capacity_footprints:
+            key = (data.get("label"), data.get("fingerprint"))
+            footprints[key] = {
+                k: data.get(k) for k in
+                ("label", "fingerprint", "predicted_bytes", "source")}
+        peaks = [w.get("peak_bytes_in_use")
+                 for w in self.capacity_watermarks
+                 if isinstance(w.get("peak_bytes_in_use"),
+                               (int, float))]
+        in_use = [w.get("bytes_in_use") for w in self.capacity_watermarks
+                  if isinstance(w.get("bytes_in_use"), (int, float))]
+        headroom = [w.get("headroom_frac")
+                    for w in self.capacity_watermarks
+                    if isinstance(w.get("headroom_frac"), (int, float))]
+        coverage = usage.get("coverage") or {
+            "leases": None,
+            "leases_sampled": None,
+            "watermark_samples": len(self.capacity_watermarks),
+            "predicted_only": not self.capacity_watermarks,
+            "complete": False,
+        }
+        rejects = {
+            "count": len(self.capacity_rejects),
+            "signatures": sorted({r.get("signature")
+                                  for r in self.capacity_rejects
+                                  if r.get("signature")}),
+            "last": (self.capacity_rejects[-1]
+                     if self.capacity_rejects else None),
+        }
+        return {
+            "footprints": [footprints[k] for k in sorted(
+                footprints, key=lambda k: (str(k[0]), str(k[1])))],
+            "watermarks": {
+                "samples": len(self.capacity_watermarks),
+                "peak_bytes_in_use": max(peaks) if peaks else None,
+                "max_bytes_in_use": max(in_use) if in_use else None,
+                "headroom_frac_max": (max(headroom) if headroom
+                                      else None),
+            },
+            "reconciliation": usage.get("reconciliation"),
+            "rejections": rejects,
+            "evictions": len(self.capacity_evictions),
+            "oom_bundles": [d.get("path") for d in self.capacity_oom],
+            "tenants": usage.get("tenants"),
+            "goodput": usage.get("goodput"),
+            "total_chip_s": usage.get("total_chip_s"),
+            "committed_steps": usage.get("committed_steps"),
+            "waste_chip_s": usage.get("waste_chip_s"),
+            "capacity_bytes": usage.get("capacity_bytes"),
+            "headroom": usage.get("headroom"),
+            "resident_predicted_bytes":
+                usage.get("resident_predicted_bytes"),
+            "accounts": self.capacity_accounts[-64:],
+            "coverage": coverage,
+        }
+
     def latency(self):
         """Request-scoped critical-path latency attribution
         (:mod:`pystella_tpu.obs.spans` over the schema-v2 trace
@@ -1480,6 +1578,7 @@ class PerfLedger:
             "alerts": self.alerts(),
             "fleet": self.fleet(),
             "perf": self.perf(),
+            "capacity": self.capacity(),
             "lint": self.lint,
             "scopes": self.scopes,
             "trace_file": self.trace_file,
@@ -2146,6 +2245,75 @@ def render_markdown(rep):
             lines.append(
                 "- **warm-fingerprint divergence**: "
                 + ", ".join(f"`{s}`" for s in fl["divergence"]))
+        lines.append("")
+    cap = rep.get("capacity")
+    if cap:
+        lines += ["## Capacity & goodput (obs.capacity)", ""]
+        cov = cap.get("coverage") or {}
+        wm = cap.get("watermarks") or {}
+        lines.append(
+            f"- {_fmt(wm.get('samples'), '.0f', '0')} watermark "
+            f"sample(s) over {_fmt(cov.get('leases'), '.0f')} "
+            f"lease(s) ("
+            + ("complete coverage" if cov.get("complete") else
+               ("predicted-only — stat-less backend"
+                if cov.get("predicted_only") else "PARTIAL coverage"))
+            + ")")
+        rec = cap.get("reconciliation")
+        if rec:
+            lines.append(
+                f"- reconciliation: predicted "
+                f"{_fmt(rec.get('predicted_bytes'), ',.0f')} B vs peak "
+                f"{_fmt(rec.get('peak_bytes_in_use'), ',.0f')} B in use "
+                f"(rel err {_fmt(rec.get('rel_err'), '.1%')})")
+        fps = cap.get("footprints") or []
+        if fps:
+            lines += ["", "| program | fingerprint | predicted bytes "
+                      "| source |", "|---|---|---|---|"]
+            for row in fps:
+                lines.append(
+                    f"| `{row.get('label')}` "
+                    f"| `{row.get('fingerprint') or '—'}` "
+                    f"| {_fmt(row.get('predicted_bytes'), ',.0f')} "
+                    f"| {row.get('source')} |")
+            lines.append("")
+        rej = cap.get("rejections") or {}
+        if rej.get("count"):
+            last = rej.get("last") or {}
+            lines.append(
+                f"- **{rej['count']} CapacityExceeded rejection(s)** "
+                f"({', '.join(f'`{s}`' for s in rej.get('signatures') or [])}) "
+                f"— last: predicted "
+                f"{_fmt(last.get('predicted_bytes'), ',.0f')} B over "
+                f"budget {_fmt(last.get('budget_bytes'), ',.0f')} B")
+        if cap.get("evictions"):
+            lines.append(
+                f"- {cap['evictions']} warm-pool eviction(s) under the "
+                "queue-behind-eviction policy")
+        for path in cap.get("oom_bundles") or []:
+            lines.append(f"- **OOM forensic bundle**: `{path}`")
+        tenants = cap.get("tenants") or {}
+        if tenants:
+            lines += ["", "| tenant | requests | chip-s | waste chip-s "
+                      "| committed steps | goodput steps/chip-s |",
+                      "|---|---|---|---|---|---|"]
+            for name in sorted(tenants):
+                row = tenants[name]
+                lines.append(
+                    f"| `{name}` | {_fmt(row.get('requests'), '.0f')} "
+                    f"| {_fmt(row.get('chip_s'))} "
+                    f"| {_fmt(row.get('waste_chip_s'))} "
+                    f"| {_fmt(row.get('committed_steps'), '.0f')} "
+                    f"| {_fmt(row.get('goodput'))} |")
+            lines.append("")
+        if cap.get("goodput") is not None:
+            lines.append(
+                f"- goodput: **{_fmt(cap.get('goodput'))} committed "
+                f"member-steps per chip-second** "
+                f"({_fmt(cap.get('committed_steps'), '.0f', '0')} steps "
+                f"/ {_fmt(cap.get('total_chip_s'))} chip-s, "
+                f"{_fmt(cap.get('waste_chip_s'))} chip-s replay+drain "
+                "waste)")
         lines.append("")
     ff = rep.get("fft")
     if ff:

@@ -295,6 +295,7 @@ class LiveServer:
                 "active_lease": status.get("active_lease"),
                 "supervisor": status.get("supervisor"),
                 "leases_completed": status.get("leases_completed"),
+                "capacity": status.get("capacity"),
             })
         if self.slo is not None:
             out["slo_alerting"] = self.slo.state()["alerting"]

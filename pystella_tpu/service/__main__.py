@@ -4,6 +4,8 @@
 
     python -m pystella_tpu.service status --events run_events.jsonl \
         [--last 10] [--json]
+    python -m pystella_tpu.service usage --events run_events.jsonl \
+        [--last 10] [--json]
 
 No live server handle required: the scenario service's whole decision
 record is its event log (``service_request`` / ``service_dispatch`` /
@@ -35,6 +37,15 @@ registered ``PYSTELLA_FLEET_DIR``), classifies every record
 live/stale/withdrawn by heartbeat age, and polls each live replica's
 own endpoint for one serve-loop + SLO line — a per-replica table of
 everything currently announced. Combine with ``--follow`` to tail it.
+
+``usage`` is the chargeback view over the SAME reconstruction: the
+per-tenant chip-second accounts the capacity monitor
+(:mod:`pystella_tpu.obs.capacity`) attributed at serve-loop retire —
+chip-seconds leased, waste (replay + preempt-drain), committed
+member-steps, goodput — plus every ``CapacityExceeded`` rejection
+(never admitted, billed zero). ``status`` (non-follow) additionally
+prints one live HBM-headroom line when ``--url`` or the registered
+``PYSTELLA_LIVE_PORT`` names a reachable endpoint.
 """
 
 from __future__ import annotations
@@ -54,8 +65,10 @@ def reconstruct(events_path):
     """Replay the event-log family into the service's current state:
     ``{queue: [...], queue_depth, tenants: {tenant: {...}}, leases:
     {active, completed, failed}, armed: [...], retired: [...],
-    done: {...}}``. Pure function of the log — drives both the CLI
-    rendering and the tests."""
+    done: {...}, capacity: {accounts, usage, rejects}}``. Pure
+    function of the log — ONE reader drives ``status``, ``usage``,
+    and the tests (the chargeback view is the same replay, rendered
+    from its ``capacity`` key)."""
     all_evs = _events.read_events(events_path, include_rotated=True)
     # deploy-time arming happens BEFORE serve() emits service_start,
     # so the armed-signature list reads the whole log; everything else
@@ -82,6 +95,9 @@ def reconstruct(events_path):
     retired = []
     tenants = {}
     done = None
+    capacity_accounts = []
+    capacity_rejects = []
+    capacity_usage = None
 
     def req(rid):
         return requests.setdefault(rid, {"id": rid, "status": "?"})
@@ -147,6 +163,12 @@ def reconstruct(events_path):
                             "deadline_missed":
                                 data.get("deadline_missed"),
                             "retire_ts": ev.get("ts")})
+        elif kind == "capacity_account":
+            capacity_accounts.append(dict(data))
+        elif kind == "capacity_reject":
+            capacity_rejects.append(dict(data))
+        elif kind == "capacity_usage":
+            capacity_usage = dict(data)
         elif kind == "service_done":
             done = data
     queue = [r for r in requests.values() if r.get("status") == "queued"]
@@ -170,6 +192,9 @@ def reconstruct(events_path):
         "armed": armed,
         "retired": retired,
         "done": done,
+        "capacity": {"accounts": capacity_accounts,
+                     "usage": capacity_usage,
+                     "rejects": capacity_rejects},
     }
 
 
@@ -217,6 +242,111 @@ def _render(state, last):
                 + (f" trace {row.get('trace')}"
                    if row.get("trace") else ""))
     return "\n".join(lines)
+
+
+def _fmt_bytes(n):
+    if not isinstance(n, (int, float)):
+        return "?"
+    return f"{n / 2**20:.1f} MiB"
+
+
+def _render_usage(state, last):
+    """The chargeback view: per-tenant chip-second accounts and
+    goodput, rendered from the SAME reconstruction ``status`` uses
+    (its ``capacity`` key — one events-family reader, two views)."""
+    cap = state.get("capacity") or {}
+    usage = cap.get("usage")
+    accounts = cap.get("accounts") or []
+    rejects = cap.get("rejects") or []
+    lines = []
+    if not usage and not accounts:
+        lines.append(
+            "no chip-second accounts in this log — usage is "
+            "attributed at serve-loop retire (capacity_usage); the "
+            "loop may still be running, or the capacity monitor was "
+            "disabled (ScenarioService(capacity=False))")
+        if rejects:
+            lines.append(f"{len(rejects)} CapacityExceeded "
+                         "rejection(s) recorded:")
+            for r in rejects[-last:]:
+                lines.append(
+                    f"  #{r.get('id')} {r.get('tenant')} "
+                    f"{r.get('signature')}: {r.get('reason')}")
+        return "\n".join(lines)
+    if usage:
+        goodput = usage.get("goodput")
+        lines.append(
+            f"{usage.get('requests')} attributed request(s) · "
+            f"{usage.get('total_chip_s')} chip-s leased · "
+            f"{usage.get('committed_steps')} committed member-step(s)"
+            f" · waste {usage.get('waste_chip_s')} chip-s · goodput "
+            + (f"{goodput:g} steps/chip-s"
+               if isinstance(goodput, (int, float)) else "—"))
+        cov = usage.get("coverage") or {}
+        if cov.get("predicted_only"):
+            lines.append("coverage: PREDICTED-ONLY (no live "
+                         "watermark samples on this host)")
+        tenants = usage.get("tenants") or {}
+        if tenants:
+            lines.append("tenant          req  rej  chip-s    waste"
+                         "     steps   goodput")
+            for name, row in sorted(tenants.items()):
+                g = row.get("goodput")
+                lines.append(
+                    f"{name:<15s} {row.get('requests', 0):>4d} "
+                    f"{row.get('rejected', 0):>4d} "
+                    f"{row.get('chip_s', 0.0):>8.3f} "
+                    f"{row.get('waste_chip_s', 0.0):>8.3f} "
+                    f"{row.get('committed_steps', 0):>8d}   "
+                    + (f"{g:g}" if isinstance(g, (int, float))
+                       else "—"))
+    if rejects:
+        lines.append(f"{len(rejects)} CapacityExceeded rejection(s) — "
+                     "never admitted, zero chip-seconds billed:")
+        for r in rejects[-last:]:
+            lines.append(
+                f"  #{r.get('id')} {r.get('tenant')} "
+                f"{r.get('signature')}: predicted "
+                f"{_fmt_bytes(r.get('predicted_bytes'))} vs budget "
+                f"{_fmt_bytes(r.get('budget_bytes'))}")
+    if accounts:
+        lines.append(f"last {min(last, len(accounts))} account(s):")
+        for a in accounts[-last:]:
+            g = a.get("goodput")
+            lines.append(
+                f"  #{a.get('id')} {a.get('tenant')} "
+                f"{a.get('status')}: {a.get('chip_s')} chip-s over "
+                f"{a.get('leases')} lease(s), "
+                f"{a.get('committed_steps')} step(s)"
+                + (f", goodput {g:g}"
+                   if isinstance(g, (int, float)) else "")
+                + (f", {a.get('replayed_steps')} replayed"
+                   if a.get("replayed_steps") else ""))
+    return "\n".join(lines)
+
+
+def _headroom_line(cap):
+    """One line of live HBM headroom from ``/healthz``'s ``capacity``
+    field (:meth:`CapacityMonitor.live_fields`)."""
+    if not cap:
+        return ("live capacity: no monitor attached "
+                "(ScenarioService(capacity=False))")
+    limit = cap.get("capacity_bytes")
+    frac = cap.get("headroom_frac")
+    line = (f"live capacity: resident predicted "
+            f"{_fmt_bytes(cap.get('resident_predicted_bytes'))}"
+            + (f" · in use {_fmt_bytes(cap['bytes_in_use'])} (peak "
+               f"{_fmt_bytes(cap.get('peak_bytes_in_use'))})"
+               if isinstance(cap.get("bytes_in_use"), (int, float))
+               else " · no live watermarks (predicted-only host)"))
+    if limit:
+        line += (f" · budget {_fmt_bytes(limit)} × "
+                 f"{cap.get('headroom')}"
+                 + (f" · {frac:.0%} of budget used"
+                    if isinstance(frac, (int, float)) else ""))
+    else:
+        line += " · no capacity limit configured"
+    return line
 
 
 def _live_poll(base_url, timeout=2.0):
@@ -363,9 +493,36 @@ def main(argv=None):
     ps.add_argument("--fleet-dir", default=None,
                     help="replica registry directory (default: the "
                          "registered PYSTELLA_FLEET_DIR)")
+    pu = sub.add_parser(
+        "usage", help="per-tenant chip-second chargeback: leased "
+                      "chip-seconds, waste (replay + drain), "
+                      "committed member-steps, and goodput per "
+                      "tenant — plus every CapacityExceeded "
+                      "rejection (billed zero)")
+    pu.add_argument("--events", default=None,
+                    help="run-event JSONL path (default: the registered "
+                         "PYSTELLA_EVENT_LOG)")
+    pu.add_argument("--last", type=int, default=10,
+                    help="account/rejection rows to show (default 10)")
+    pu.add_argument("--json", action="store_true",
+                    help="print the raw capacity reconstruction "
+                         "(accounts + usage rollup + rejects) instead "
+                         "of the rendered table")
     args = p.parse_args(argv)
 
     events_path = args.events or _config.getenv("PYSTELLA_EVENT_LOG")
+    if args.cmd == "usage":
+        if not events_path:
+            print("service usage: no --events and no "
+                  "PYSTELLA_EVENT_LOG set", file=sys.stderr)
+            return 2
+        state = reconstruct(events_path)
+        if args.json:
+            print(json.dumps(state["capacity"], indent=1,
+                             sort_keys=True, default=str))
+        else:
+            print(_render_usage(state, max(1, args.last)))
+        return 0
     fleet_dir = None
     if args.fleet or args.fleet_dir:
         fleet_dir = args.fleet_dir or _config.getenv("PYSTELLA_FLEET_DIR")
@@ -392,6 +549,16 @@ def main(argv=None):
         print(json.dumps(state, indent=1, sort_keys=True, default=str))
     else:
         print(_render(state, max(1, args.last)))
+        # a reachable live endpoint upgrades the offline view with the
+        # CURRENT HBM headroom (the log only carries retired usage)
+        url = args.url
+        if url is None:
+            port = _config.get_int("PYSTELLA_LIVE_PORT") or 0
+            url = f"http://127.0.0.1:{port}" if port > 0 else None
+        if url:
+            polled = _live_poll(url)
+            if polled is not None:
+                print(_headroom_line(polled[0].get("capacity")))
     return 0
 
 

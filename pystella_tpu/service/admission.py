@@ -37,9 +37,9 @@ from pystella_tpu import config as _config
 from pystella_tpu.obs import events as _events
 from pystella_tpu.obs import memory as _memory
 
-__all__ = ["AdmissionController", "AdmissionVerdict", "ColdSignature",
-           "WarmPool", "WarmPoolEntry", "parse_signature",
-           "request_signature"]
+__all__ = ["AdmissionController", "AdmissionVerdict", "CapacityExceeded",
+           "ColdSignature", "WarmPool", "WarmPoolEntry",
+           "parse_signature", "request_signature"]
 
 
 def request_signature(model, grid_shape, proc_shape=(1, 1, 1),
@@ -98,6 +98,15 @@ class ColdSignature(AdmissionVerdict):
     (``compile`` admits behind a build, ``reject`` refuses)."""
 
     kind = "cold_signature"
+
+
+class CapacityExceeded(AdmissionVerdict):
+    """The typed memory-aware rejection: resident warm-pool programs +
+    the candidate's predicted HBM footprint exceed device capacity x
+    ``PYSTELLA_CAPACITY_HEADROOM`` (and the ``evict`` policy, when
+    armed, could not free enough). Never admitted."""
+
+    kind = "capacity_exceeded"
 
 
 class WarmPoolEntry:
@@ -216,6 +225,12 @@ class WarmPool:
     def signatures(self):
         return sorted(self._entries)
 
+    def evict(self, signature):
+        """Drop an armed entry (the capacity 'evict' policy's lever);
+        returns the removed entry or ``None``. A later lease on the
+        signature re-arms cold — slower, never wrong."""
+        return self._entries.pop(str(signature), None)
+
     def arm(self, signature, builder, slots, chunk, decomp=None,
             invariants=None):
         """Arm ``signature``: ``builder(grid_shape, decomp) ->
@@ -280,11 +295,20 @@ class AdmissionController:
         ``fingerprint_ok=False``.
     :arg cold_policy: ``"compile"`` | ``"reject"`` (default: the
         registered ``PYSTELLA_SERVICE_COLD_POLICY``).
+    :arg capacity: optional :class:`~pystella_tpu.obs.capacity.
+        CapacityMonitor`; when set, every would-be-admitted verdict
+        additionally passes the memory budget — resident warm-pool
+        programs + the candidate's predicted footprint must fit
+        capacity x headroom, else the verdict becomes a typed
+        :class:`CapacityExceeded` rejection (after the ``evict``
+        policy, when armed, failed to free enough).
     """
 
-    def __init__(self, pool, store=None, cold_policy=None):
+    def __init__(self, pool, store=None, cold_policy=None,
+                 capacity=None):
         self.pool = pool
         self.store = store
+        self.capacity = capacity
         if cold_policy is None:
             cold_policy = _config.getenv("PYSTELLA_SERVICE_COLD_POLICY")
         cold_policy = str(cold_policy).strip().lower()
@@ -306,8 +330,18 @@ class AdmissionController:
 
     def admit(self, request):
         """The admission decision for one request (no queue side
-        effects — the service enqueues on a positive verdict)."""
+        effects — the service enqueues on a positive verdict). With a
+        capacity monitor attached, an admitted verdict additionally
+        passes the memory budget (:meth:`_capacity_verdict`)."""
         entry = self.pool.get(request.signature)
+        verdict = self._base_verdict(request, entry)
+        if verdict.admitted and self.capacity is not None:
+            capacity_verdict = self._capacity_verdict(request, entry)
+            if capacity_verdict is not None:
+                return capacity_verdict
+        return verdict
+
+    def _base_verdict(self, request, entry):
         if entry is not None:
             problems = self._artifact_problems(request.signature)
             if not entry.fingerprint_ok():
@@ -334,3 +368,46 @@ class AdmissionController:
                     f"{request.signature!r}"
                     + ("" if admitted
                        else " (policy rejects cold signatures)")))
+
+    def _capacity_verdict(self, request, entry):
+        """``None`` when the request fits the memory budget (or the
+        budget is unknowable — the monitor admits honestly); a
+        :class:`CapacityExceeded` rejection otherwise. The ``evict``
+        policy drops other idle armed entries oldest-first and
+        re-checks before giving up."""
+        monitor = self.capacity
+        predicted = monitor.candidate_bytes(request.signature, entry)
+        decision = monitor.admission_check(request.signature, predicted)
+        if not decision["admitted"] and monitor.policy == "evict":
+            victims = sorted(
+                (sig for sig in self.pool.signatures()
+                 if sig != str(request.signature)),
+                key=lambda sig: self.pool.get(sig).armed_ts)
+            for sig in victims:
+                evicted = self.pool.evict(sig)
+                monitor.note_evicted(sig)
+                _events.emit(
+                    "capacity_evict", signature=sig,
+                    for_signature=request.signature,
+                    fingerprint=getattr(evicted, "fingerprint", None),
+                    resident_bytes=monitor.resident_bytes())
+                decision = monitor.admission_check(
+                    request.signature, predicted)
+                if decision["admitted"]:
+                    break
+        if decision["admitted"]:
+            return None
+        _events.emit(
+            "capacity_reject", id=request.id, tenant=request.tenant,
+            signature=request.signature,
+            predicted_bytes=decision.get("predicted_bytes"),
+            resident_bytes=decision.get("resident_bytes"),
+            capacity_bytes=decision.get("capacity_bytes"),
+            budget_bytes=decision.get("budget_bytes"),
+            headroom=decision.get("headroom"),
+            policy=decision.get("policy"),
+            reason=decision.get("reason"))
+        return CapacityExceeded(
+            request, False, entry is not None,
+            reason=decision.get("reason", "capacity exceeded"),
+            fingerprint=getattr(entry, "fingerprint", None))

@@ -21,6 +21,14 @@ TPU-window ``service`` leg scales it up):
   the same warm program and reports ``preempt_bitexact``;
 - **one quota rejection**: the heaviest tenant submits one request past
   its admission quota;
+- **one certain capacity rejection**: after arming, the capacity
+  monitor's budget (:mod:`pystella_tpu.obs.capacity`) is pinned to a
+  deterministic multiple of the resident predicted footprint, and a
+  seeded "hog" signature whose recorded footprint is TWICE the whole
+  budget is submitted — ``CapacityExceeded`` by construction, so every
+  smoke record carries one memory-aware rejection (and, at retire,
+  per-tenant chip-second accounts with healthy goodput for the
+  tenants that ran);
 - **one certain SLO burn alert**: a seeded
   :class:`~pystella_tpu.obs.slo.SLOMonitor` rides the run
   (:func:`seeded_slo_monitor`) with its ``deadline_miss`` leg windowed
@@ -208,7 +216,8 @@ def _uninterrupted_reference(entry, request, slots, chunk):
 
 def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
         cold_grid=12, nsteps=8, quota=3, label="loadgen",
-        spectra=True, faults=None, store=None, slo=None):
+        spectra=True, faults=None, store=None, slo=None,
+        capacity=None):
     """Drive one full synthetic service run (module docstring).
     Returns the stats dict (also emitted as a ``service_loadgen``
     event). ``grid``/``cold_grid`` are the warm/cold lattice edges;
@@ -217,7 +226,10 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
     FaultInjector into every lease's supervisor (drills); ``slo`` an
     :class:`~pystella_tpu.obs.slo.SLOMonitor` override (default: the
     :func:`seeded_slo_monitor`; ``False`` disables the live monitor
-    entirely, restoring the pre-live event record byte for byte)."""
+    entirely, restoring the pre-live event record byte for byte);
+    ``capacity`` a :class:`~pystella_tpu.obs.capacity.CapacityMonitor`
+    override (``False`` disables the capacity plane — no budget pin,
+    no hog submission, no chip-second attribution)."""
     import pystella_tpu as ps
 
     rng = np.random.default_rng(seed)
@@ -235,13 +247,28 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
     service = ScenarioService(checkpoint_dir, slots=slots, chunk=chunk,
                               scheduler=scheduler, results=results,
                               store=store, faults=faults, slo=slo,
-                              label=label)
+                              capacity=capacity, label=label)
     service.register_model("preheat", build_preheat_model())
 
     # deploy-time arming: the warm signature's program is traced,
     # compiled, and dispatched once HERE — before any request exists,
     # so no request's latency ever contains it
     service.arm(warm_sig)
+    # the capacity drill: pin a deterministic HBM budget AFTER the
+    # warm program is armed — the resident footprint (plus the cold
+    # build) fits with a wide margin, while a seeded "hog" signature
+    # whose recorded footprint is twice the WHOLE budget cannot fit
+    # under any headroom, so exactly one CapacityExceeded rejection
+    # lands in every run regardless of lattice sizes or backend
+    hog_sig = request_signature("preheat", (grid * 4,) * 3)
+    cap_budget = None
+    if service.capacity is not None:
+        cap_budget = int(max(service.capacity.resident_bytes(), 1) * 64)
+        service.capacity.capacity_bytes = cap_budget
+        service.capacity.ledger.record(
+            f"service.{hog_sig}", fingerprint="loadgen-hog",
+            predicted_bytes=2 * cap_budget, source="aval_estimate",
+            persist=False)
     if spectra:
         # retire-time per-member spectra through the planner-selected
         # transform tier (the fused pencil path whenever the service
@@ -279,6 +306,13 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
                         seed=int(rng.integers(100))),
     ]
     verdicts = [service.submit(r) for r in mix]
+    hog_verdict = None
+    if service.capacity is not None:
+        # the certain CapacityExceeded: charlie is under quota, the
+        # signature's recorded footprint is 2x the budget — the BASE
+        # verdict admits, the capacity verdict must refuse
+        hog = ScenarioRequest("charlie", hog_sig, nsteps, seed=99)
+        hog_verdict = service.submit(hog)
     high = ScenarioRequest("charlie", warm_sig, nsteps,
                            seed=8, priority=3)
     service.schedule_arrival(1, high)
@@ -310,7 +344,8 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
                  if r.deadline_missed is not None]
     stats = {
         **summary,
-        "requests": len(mix) + 1,
+        "requests": len(mix) + 1 + (1 if hog_verdict is not None
+                                    else 0),
         "warm_admissions": sum(1 for v in verdicts
                                if v.admitted and v.warm),
         "cold_admissions": sum(1 for v in verdicts
@@ -323,11 +358,23 @@ def run(checkpoint_dir, seed=0, slots=None, chunk=None, grid=16,
         # one trace id per request, end to end: the preempted requests
         # prove trace survival across requeue (their several
         # service_dispatch events share the id)
-        "traces": sorted(r.trace_id for r in mix + [high]
-                         if r.trace_id is not None),
+        "traces": sorted(
+            r.trace_id for r in mix + [high]
+            + ([hog] if hog_verdict is not None else [])
+            if r.trace_id is not None),
         "serve_wall_s": round(serve_wall_s, 4),
     }
-    if slo is not None:
+    if service.capacity is not None:
+        stats["capacity"] = {
+            "budget_bytes": cap_budget,
+            "hog_rejected": bool(
+                hog_verdict is not None
+                and getattr(hog_verdict, "kind", None)
+                == "capacity_exceeded"),
+            "resident_predicted_bytes":
+                service.capacity.resident_bytes(),
+            "watermark_samples": len(service.capacity.watermarks),
+        }
         state = slo.state()
         stats["slo"] = {
             "alerts": state["alerts_total"],

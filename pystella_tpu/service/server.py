@@ -249,6 +249,13 @@ class ScenarioService:
         ``PYSTELLA_LIVE_PORT`` endpoint serves its state at ``/slo``).
         When the live endpoint is on and no monitor was given, a
         default one is built.
+    :arg capacity: optional :class:`~pystella_tpu.obs.capacity.
+        CapacityMonitor` (default-built; ``False`` disables the
+        capacity plane). Threaded into the admission controller for
+        the memory budget, polled per chunk for live watermarks,
+        consulted on a RESOURCE_EXHAUSTED lease failure for the OOM
+        forensic bundle, and finalized at the end of the serve loop
+        into per-tenant chip-second accounts (``capacity_usage``).
     :arg label: tag carried on every event.
     """
 
@@ -256,8 +263,8 @@ class ScenarioService:
                  scheduler=None, pool=None, admission=None, store=None,
                  results=None, preempt=None, checkpoint_chunks=2,
                  faults=None, retry=None, planner_factory=None,
-                 cold_policy=None, slo=None, label="service",
-                 live_port=None, fleet_id=None):
+                 cold_policy=None, slo=None, capacity=None,
+                 label="service", live_port=None, fleet_id=None):
         self.checkpoint_dir = os.path.abspath(str(checkpoint_dir))
         self.slots = int(slots if slots is not None
                          else _config.get_int("PYSTELLA_SERVICE_SLOTS"))
@@ -268,8 +275,20 @@ class ScenarioService:
         self.scheduler = scheduler or FairShareScheduler()
         self.pool = pool or WarmPool()
         self.store = store
+        if capacity is None:
+            from pystella_tpu.obs.capacity import CapacityMonitor
+            capacity = CapacityMonitor()
+        self.capacity = capacity or None    # False -> disabled
+        if self.capacity is not None:
+            # subscribe NOW, not at serve(): submissions and arming
+            # precede the serve loop, and retire-time chip-second
+            # attribution needs their service_request root spans in
+            # the monitor's buffer (subscribe is idempotent — the
+            # _live_begin re-subscribe covers a reconfigured log)
+            _events.get_log().subscribe(self.capacity.handle)
         self.admission = admission or AdmissionController(
-            self.pool, store=store, cold_policy=cold_policy)
+            self.pool, store=store, cold_policy=cold_policy,
+            capacity=self.capacity)
         self.results = results or ResultEmitter(label=label)
         if preempt is None:
             preempt = _config.get_bool("PYSTELLA_SERVICE_PREEMPT")
@@ -323,9 +342,12 @@ class ScenarioService:
             raise KeyError(
                 f"no model {model!r} registered (signature "
                 f"{signature!r}); register_model() first")
-        return self.pool.arm(signature, builder, slots=self.slots,
-                             chunk=self.chunk, decomp=decomp,
-                             invariants=invariants)
+        entry = self.pool.arm(signature, builder, slots=self.slots,
+                              chunk=self.chunk, decomp=decomp,
+                              invariants=invariants)
+        if self.capacity is not None:
+            self.capacity.note_armed(signature, entry)
+        return entry
 
     # -- ingestion -----------------------------------------------------------
 
@@ -428,6 +450,11 @@ class ScenarioService:
         self._last_chunk_ts = now
         _metrics.counter("service.chunks").inc()
         self._total_chunks += 1
+        if self.capacity is not None:
+            # per-chunk live HBM watermark (no-op on stat-less
+            # backends — coverage then reads predicted_only, honestly)
+            self.capacity.poll_watermark(lease=lease.id,
+                                         step=self._total_chunks)
         self._poll_arrivals()
         if (self.preempt_enabled and lease.supervisor is not None
                 and self.scheduler.has_priority_above(lease.priority)):
@@ -484,6 +511,8 @@ class ScenarioService:
             "warm_pool": {"ok": pool_ok, "stale": pool_stale},
             "last_chunk_member_steps_per_s":
                 self.last_chunk_member_steps_per_s,
+            "capacity": (self.capacity.live_fields()
+                         if self.capacity is not None else None),
         }
 
     def _live_begin(self):
@@ -504,6 +533,11 @@ class ScenarioService:
         if self.slo is not None:
             _events.get_log().subscribe(self.slo.handle)
             attached = True
+        if self.capacity is not None:
+            # the capacity monitor rides the same push channel: it
+            # buffers the span stream for retire-time attribution and
+            # upgrades footprints from byte-bearing compile events
+            _events.get_log().subscribe(self.capacity.handle)
         if enabled:
             from pystella_tpu.obs import live as _live
             self.live_server = _live.start_from_env(
@@ -529,6 +563,8 @@ class ScenarioService:
             self.slo.evaluate()
         if attached:
             _events.get_log().unsubscribe(self.slo.handle)
+        if self.capacity is not None:
+            _events.get_log().unsubscribe(self.capacity.handle)
         if self.fleet_registry is not None:
             # a no-op after kill(): a "crashed" drill replica must not
             # tombstone itself on the way out
@@ -586,6 +622,18 @@ class ScenarioService:
                 self.totals["replayed_member_steps"],
             "tenant_steps": dict(self.totals["tenant_steps"]),
         }
+        if self.capacity is not None:
+            try:
+                usage = self.capacity.finalize_usage(label=self.label)
+            except Exception as e:  # noqa: BLE001 — chargeback is
+                # telemetry; its failure must never kill a clean drain
+                _events.emit("obs_subscriber_error",
+                             subscriber="capacity.finalize_usage",
+                             error=f"{type(e).__name__}: {e}")
+                usage = None
+            if usage is not None:
+                summary["goodput"] = usage.get("goodput")
+                summary["total_chip_s"] = usage.get("total_chip_s")
         _events.emit("service_done", **summary)
         return summary
 
@@ -672,6 +720,7 @@ class ScenarioService:
         _events.emit(
             "service_lease", lease=lease_id, signature=signature,
             priority=lease.priority, requests=len(requests),
+            chips=self._lease_chips(entry),
             warm=lease_warm, ttfs_s=lease.ttfs_s,
             cold_build_s=round(cold_build_s, 4),
             trace_s=round(w.trace_seconds, 4),
@@ -686,6 +735,19 @@ class ScenarioService:
             self._requeue_preempted(lease, rep)
         self._emit_results(lease)
         return rep
+
+    @staticmethod
+    def _lease_chips(entry):
+        """Chips a lease against ``entry`` holds — the mesh slice's
+        device count (1 on the single-device tier). The chip-second
+        accounts (:mod:`pystella_tpu.obs.capacity`) bill phases x this."""
+        decomp = getattr(entry, "decomp", None)
+        if decomp is not None:
+            try:
+                return int(decomp.mesh.devices.size)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        return 1
 
     def _supervised_run(self, lease):
         from pystella_tpu import Checkpointer
@@ -719,6 +781,22 @@ class ScenarioService:
                      signature=lease.entry.signature,
                      error=f"{type(error).__name__}: {error}",
                      label=self.label)
+        if self.capacity is not None:
+            from pystella_tpu.obs import capacity as _capacity
+            if _capacity.is_resource_exhausted(error):
+                # an allocator OOM got past admission: bundle the
+                # resident footprint table, the watermark series, and
+                # the decision that let it through (PR-4 forensics)
+                try:
+                    self.capacity.write_oom_bundle(
+                        os.path.join(self.checkpoint_dir, "forensics"),
+                        error, signature=lease.entry.signature,
+                        lease=lease.id, label=self.label)
+                except Exception as e:  # noqa: BLE001 — forensics are
+                    # best-effort; the requeue below must still run
+                    _events.emit("forensic_failed",
+                                 reason=f"{type(e).__name__}: {e}",
+                                 label=self.label)
         for m in lease.active_members():
             req = lease.requests[m]
             req.failures += 1

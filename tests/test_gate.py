@@ -721,7 +721,8 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     # replays — the report's `service` section carries all of it
     sv = rep["service"]
     assert sv["completed"] == 8 and sv["diverged"] == 0
-    assert sv["rejected"] == {"quota": 1}
+    # the quota rejection plus the PR-19 seeded capacity hog
+    assert sv["rejected"] == {"quota": 1, "capacity_exceeded": 1}
     assert sv["preemptions"] == 1
     assert sv["warm_claimed"] is True
     assert all(a["fingerprint_ok"] for a in sv["warm_admissions"])
@@ -749,7 +750,7 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     # seeded deadline pair recorded one MISS and one hit, and the
     # Perfetto service timeline sits next to the report
     lat = rep["latency"]
-    assert lat["traced"] == lat["assembled"] == 9
+    assert lat["traced"] == lat["assembled"] == 10
     assert lat["unassembled"] == []
     assert lat["phase_sum_check"]["ok"] is True
     assert lat["phase_sum_check"]["max_rel_err"] < 0.05
@@ -768,7 +769,7 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     from pystella_tpu.obs import trace as obs_trace
     svc_rows = obs_trace.parse_trace_file(svc_trace_path)
     svc_table = obs_trace.scope_durations(svc_rows)
-    assert svc_table.get("service_request_span", {}).get("count") == 9
+    assert svc_table.get("service_request_span", {}).get("count") == 10
     # the fleet drill ran end to end: two replicas announced into the
     # registry and aggregated live (the queue-depth gauge federated
     # per replica), the seeded fleet burn alert fired AND resolved
@@ -801,6 +802,31 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
             "fleet_resolved", "fleet_replica_lost", "fleet_withdraw",
             "fleet_loadgen"} <= fleet_kinds
     assert "smoke_fleet_failed" not in fleet_kinds
+    # the capacity & goodput plane ran end to end: every armed program
+    # footprinted, the seeded hog rejected with the predicted-vs-budget
+    # numbers that justify it, per-tenant chip-second accounts with
+    # positive goodput, no OOM, and the CPU host's coverage honestly
+    # predicted-only (zero watermark samples, never claimed complete)
+    cp = rep["capacity"]
+    assert cp["footprints"], cp
+    assert cp["rejections"]["count"] == 1
+    rej = cp["rejections"]["last"]
+    assert rej["tenant"] == "charlie"
+    assert rej["predicted_bytes"] > rej["budget_bytes"]
+    assert cp["goodput"] and cp["goodput"] > 0
+    assert cp["committed_steps"] > 0 and cp["total_chip_s"] > 0
+    assert set(cp["tenants"]) == {"alpha", "bravo", "charlie"}
+    cap_cov = cp["coverage"]
+    assert cap_cov["predicted_only"] is True
+    assert cap_cov["complete"] is False
+    assert cap_cov["watermark_samples"] == 0
+    assert cp["oom_bundles"] == []
+    assert "Capacity & goodput" in md
+    cap_kinds = {r["kind"] for r in events.read_events(
+        os.path.join(out, "smoke_events.jsonl"))}
+    assert {"capacity_footprint", "capacity_reject",
+            "capacity_account", "capacity_usage"} <= cap_kinds
+    assert "smoke_capacity_failed" not in cap_kinds
     lint_rep = json.load(open(os.path.join(out, "lint_report.json")))
     spec_stats = lint_rep["graph"]["smoke_spectra"]
     coll = spec_stats["collectives"]
@@ -959,6 +985,32 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     assert gate.main(["--baseline", report_path,
                       "--current", fake_fleet_path, "--no-fleet"]) == 0
     capsys.readouterr()
+    # the capacity half of the same honesty rule: the CPU smoke's
+    # predicted-only coverage is annotated on the self-comparison...
+    assert any("predicted-only" in w for w in self_verdict["warnings"])
+    # ... while the SAME record mutated into a complete-coverage claim
+    # over its zero watermark samples is refused, exit 2
+    fake_cap = json.loads(json.dumps(rep))
+    fake_cap["capacity"]["coverage"].update(
+        complete=True, predicted_only=False, leases=5, leases_sampled=5)
+    fake_cap_verdict = gate.compare_reports(rep, fake_cap)
+    assert fake_cap_verdict["exit_code"] == 2
+    assert any(r.startswith("invalid_evidence: report claims complete "
+                            "capacity coverage") for r in
+               fake_cap_verdict["reasons"])
+    fake_cap_path = str(tmp_path / "fake_capacity.json")
+    json.dump(fake_cap, open(fake_cap_path, "w"))
+    assert gate.main(["--baseline", report_path,
+                      "--current", fake_cap_path, "--no-capacity"]) == 0
+    capsys.readouterr()
+    # goodput regression on the REAL smoke report: chips burning on
+    # waste drives the gate to exit 1 naming goodput
+    burned = json.loads(json.dumps(rep))
+    burned["capacity"]["goodput"] = rep["capacity"]["goodput"] / 10.0
+    burned_verdict = gate.compare_reports(rep, burned)
+    assert burned_verdict["exit_code"] == 1
+    assert any("goodput regression" in r
+               for r in burned_verdict["reasons"])
 
     # synthetic contamination burst -> invalid evidence (the detector
     # is forced on: auto-mode skips it for CPU reports, where scheduler

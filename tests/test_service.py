@@ -466,7 +466,9 @@ def test_loadgen_mix_and_service_section(loadgen_report):
     stats, rep = loadgen_report
     assert stats["preempt_bitexact"] is True
     assert stats["preemptions"] == 1
-    assert stats["rejected"] == {"quota": 1}
+    # the quota rejection plus the PR-19 seeded capacity hog
+    assert stats["rejected"] == {"quota": 1, "capacity_exceeded": 1}
+    assert stats["capacity"]["hog_rejected"] is True
     assert stats["warm_admissions"] == 6
     assert stats["cold_admissions"] == 1
     assert stats["completed"] == 8
@@ -479,7 +481,7 @@ def test_loadgen_mix_and_service_section(loadgen_report):
 
     sv = rep["service"]
     assert sv["completed"] == 8 and sv["diverged"] == 0
-    assert sv["rejected"] == {"quota": 1}
+    assert sv["rejected"] == {"quota": 1, "capacity_exceeded": 1}
     assert sv["preemptions"] == 1
     assert sv["warm_claimed"] is True
     assert all(a["fingerprint_ok"] for a in sv["warm_admissions"])
