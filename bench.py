@@ -1720,6 +1720,10 @@ def run_smoke(argv=None):
     # lint_report.json next to the perf report
     from pystella_tpu import lint as _lint
     lint_rep = _lint.run_lint(run_graph=False)
+    # per-target static comm model blocks (dataflow tier) — joined by
+    # the ledger against the measured halo/fft traffic into the
+    # report's modeled-vs-measured `comm` section
+    static_comm = {}
     try:
         # the donation audit reads the DONATED production program's
         # StableHLO; when the dispatch policy ran the undonated twin
@@ -1741,9 +1745,15 @@ def run_smoke(argv=None):
             dtype_policy=_lint.POLICY_F32,
             fused_scopes=("rk_stage",))
         lint_rep.extend(graph_violations)
+        df_viol, df_stats = _lint.audit_dataflow_artifacts(
+            "smoke_step", asm, compiled.as_text(),
+            dtype_policy=_lint.POLICY_F32)
+        lint_rep.extend(df_viol)
+        graph_stats.update(df_stats)
+        static_comm["smoke_step"] = df_stats["static_comm"]
         lint_rep.graph = {"smoke_step": graph_stats}
         lint_rep.donation = graph_stats.get("donation")
-        for chk in _lint.GRAPH_CHECKS:
+        for chk in _lint.GRAPH_CHECKS + _lint.DATAFLOW_CHECKS:
             lint_rep.add_check(chk)
     except Exception as e:  # noqa: BLE001 — record, never kill the run
         lint_rep.extend([_lint.Violation(
@@ -1768,6 +1778,12 @@ def run_smoke(argv=None):
                 collectives=dict(TRANSPOSE_COLLECTIVES),
                 fused_scopes=("fft_stage", "fft_transpose"))
             lint_rep.extend(s_viol)
+            sdf_viol, sdf_stats = _lint.audit_dataflow_artifacts(
+                "smoke_spectra", s_asm, s_hlo,
+                dtype_policy=_lint.POLICY_SPECTRAL_F32)
+            lint_rep.extend(sdf_viol)
+            s_stats.update(sdf_stats)
+            static_comm["smoke_spectra"] = sdf_stats["static_comm"]
             lint_rep.graph = {**(lint_rep.graph or {}),
                               "smoke_spectra": s_stats}
         except Exception as e:  # noqa: BLE001 — record, never kill it
@@ -1776,6 +1792,27 @@ def run_smoke(argv=None):
                 severity="warning",
                 message=f"IR audit of the spectra program failed: "
                         f"{type(e).__name__}: {e}")])
+    if overlap_seg is not None:
+        # static comm model of the overlapped-halo program — the very
+        # program the halo_traffic event measures, so the ledger's comm
+        # section can put modeled and measured halo bytes side by side
+        try:
+            _, ofd_a, ox_a = overlap_seg
+            o_asm, o_hlo = _lint.lower_and_compile(
+                jax.jit(lambda x: ofd_a.lap(x)), (ox_a,))
+            o_viol, o_stats = _lint.audit_dataflow_artifacts(
+                "smoke_overlap", o_asm, o_hlo,
+                dtype_policy=_lint.POLICY_F32)
+            lint_rep.extend(o_viol)
+            static_comm["smoke_overlap"] = o_stats["static_comm"]
+            lint_rep.graph = {**(lint_rep.graph or {}),
+                              "smoke_overlap": o_stats}
+        except Exception as e:  # noqa: BLE001 — record, never kill it
+            lint_rep.extend([_lint.Violation(
+                checker="graph-build", where="smoke_overlap",
+                severity="warning",
+                message=f"dataflow audit of the overlap program "
+                        f"failed: {type(e).__name__}: {e}")])
     lint_path = lint_rep.write(os.path.join(args.out, "lint_report.json"))
     lint_summary = lint_rep.summary()
     hb(f"smoke: lint {'PASS' if lint_rep.ok else 'FAIL'} "
@@ -1785,6 +1822,7 @@ def run_smoke(argv=None):
              warnings=lint_summary["warnings"],
              checks=lint_summary["checks"],
              donation=lint_summary.get("donation"),
+             static_comm=static_comm or None,
              first_errors=[str(v) for v in lint_rep.errors[:5]],
              report_path=lint_path)
 

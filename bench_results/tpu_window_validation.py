@@ -200,15 +200,40 @@ def worker_overlap(dry_run):
 
 
 def worker_lint_tpu(dry_run):
+    """Static-analysis leg on the HARDWARE lowering: the lint CLI with
+    PYSTELLA_LINT_PLATFORM=tpu audits the Mosaic/TPU HLO rather than
+    the CPU stand-in, and the written report must show the dataflow
+    tier actually ran there — both checks recorded, the bf16 chunk
+    target's precision flow clean, and a nonempty static comm model
+    for the sharded targets."""
     env = dict(os.environ)
     if not dry_run:
         env["PYSTELLA_LINT_PLATFORM"] = "tpu"
     rc = subprocess.run(
         [sys.executable, "-m", "pystella_tpu.lint", "--out", OUT],
         env={**env, "PYTHONPATH": REPO}, timeout=2000).returncode
+    rep, dataflow_ok, bf16_ok, comm_targets = {}, False, False, 0
+    try:
+        rep = json.load(open(os.path.join(OUT, "lint_report.json")))
+        checks = set((rep.get("summary") or {}).get("checks") or [])
+        dataflow_ok = {"precision-flow", "static-comm"} <= checks
+        graph = rep.get("graph") or {}
+        bf16 = (graph.get("bf16_chunk_multi_step") or {}).get(
+            "precision") or {}
+        bf16_ok = bf16.get("ok") is True
+        comm_targets = sum(
+            1 for g in graph.values()
+            if (g.get("static_comm") or {}).get("modeled"))
+    except Exception:
+        pass
     record("lint_tpu", rc=rc,
-           platform="cpu" if dry_run else "tpu")
-    return rc
+           platform="cpu" if dry_run else "tpu",
+           dataflow_checks_ran=dataflow_ok,
+           bf16_precision_flow_ok=bf16_ok,
+           modeled_comm_targets=comm_targets,
+           lint_wall_s=(rep.get("summary") or {}).get(
+               "timing", {}).get("total_s") if rep else None)
+    return rc if rc else (0 if dataflow_ok and bf16_ok else 1)
 
 
 def worker_ensemble(dry_run):

@@ -452,6 +452,13 @@ register("PYSTELLA_LINT_PLATFORM", default="cpu", scope="driver",
          help="platform the lint CLI lowers the audited step functions "
               "on: 'cpu' (default; static analysis needs no hardware) "
               "or 'tpu'")
+register("PYSTELLA_GATE_COMM_EXCESS_PCT", default="25", kind="float",
+         scope="driver",
+         help="gate threshold for the modeled-vs-measured comm check: "
+              "measured collective traffic exceeding the dataflow "
+              "lint tier's static model by more than this percentage "
+              "fails the gate (the model is an upper bound — measured "
+              "above it means unattributed traffic)")
 register("BENCH_EVENT_LOG", default=None, kind="path", scope="driver",
          help="override for bench.py's run-event JSONL path (default "
               "bench_results/run_events.jsonl)")

@@ -1,6 +1,6 @@
 """Static-analysis layer: jaxpr/HLO hazard audits + package AST lint.
 
-Two tiers, one verdict (``lint_report.json``, gated in CI):
+Three tiers, one verdict (``lint_report.json``, gated in CI):
 
 - **IR tier** (:mod:`pystella_tpu.lint.graph` +
   :mod:`pystella_tpu.lint.targets`): trace and lower the real step
@@ -10,6 +10,14 @@ Two tiers, one verdict (``lint_report.json``, gated in CI):
   sharding constraint), host interaction (infeed/outfeed/callbacks on
   the step path), and sentinel fusion (the PR-4 health reductions must
   live INSIDE the step module).
+- **Dataflow tier** (:mod:`pystella_tpu.lint.dataflow`): def-use
+  analysis over the SAME cached artifacts — precision-flow role
+  propagation enforcing ``POLICY_BF16_ACC32`` as a flow property
+  (bf16 never on an accumulation chain, downcasts only at registered
+  carry points), and a static communication model (per-collective
+  bytes by class, field-sized replication detection) whose
+  ``static_comm`` blocks the perf ledger joins against measured
+  traffic.
 - **Source tier** (:mod:`pystella_tpu.lint.source`): AST lint over the
   package — host-sync calls in traced hot paths, ``os.environ`` reads
   outside the central registry (:mod:`pystella_tpu.config`),
@@ -17,7 +25,9 @@ Two tiers, one verdict (``lint_report.json``, gated in CI):
 
 CLI::
 
-    python -m pystella_tpu.lint [--out DIR] [--no-graph] [--no-source]
+    python -m pystella_tpu.lint [--out DIR] [--targets a,b]
+                                [--no-graph] [--no-source]
+                                [--no-dataflow]
 
 writes ``lint_report.json`` and exits nonzero on violations. The
 :class:`~pystella_tpu.obs.ledger.PerfLedger` folds a ``lint`` run event
@@ -33,8 +43,11 @@ import os
 
 from pystella_tpu.lint.report import (LINT_SCHEMA_VERSION, LintReport,
                                       Violation)
-from pystella_tpu.lint import graph, source
-from pystella_tpu.lint.graph import (GraphTarget, POLICY_BF16_ACC32,
+from pystella_tpu.lint import dataflow, graph, source
+from pystella_tpu.lint.dataflow import (audit_dataflow_artifacts,
+                                        audit_dataflow_targets)
+from pystella_tpu.lint.graph import (ArtifactCache, GraphTarget,
+                                     POLICY_BF16_ACC32,
                                      POLICY_F32, POLICY_F64,
                                      POLICY_SPECTRAL_F32,
                                      audit_artifacts, audit_target,
@@ -43,12 +56,14 @@ from pystella_tpu.lint.source import HOT_MODULES, check_package
 
 __all__ = [
     "LINT_SCHEMA_VERSION", "LintReport", "Violation",
-    "GraphTarget", "POLICY_F32", "POLICY_F64", "POLICY_BF16_ACC32",
+    "ArtifactCache", "GraphTarget",
+    "POLICY_F32", "POLICY_F64", "POLICY_BF16_ACC32",
     "POLICY_SPECTRAL_F32",
     "audit_artifacts", "audit_target", "audit_targets",
+    "audit_dataflow_artifacts", "audit_dataflow_targets",
     "lower_and_compile", "HOT_MODULES", "check_package",
     "run_lint", "package_dir", "doc_path",
-    "SOURCE_CHECKS", "DOC_CHECK", "GRAPH_CHECKS",
+    "SOURCE_CHECKS", "DOC_CHECK", "GRAPH_CHECKS", "DATAFLOW_CHECKS",
 ]
 
 #: the canonical checker names per tier — run_lint() and the smoke
@@ -60,6 +75,9 @@ SOURCE_CHECKS = ("host-sync", "env-registry", "scope-registry",
 #: doc file actually exists to check against
 DOC_CHECK = "env-doc"
 GRAPH_CHECKS = ("donation", "dtype", "collectives", "host", "fusion")
+#: the dataflow tier (pystella_tpu.lint.dataflow): precision-flow
+#: role propagation + the static communication model
+DATAFLOW_CHECKS = dataflow.DATAFLOW_CHECKS
 
 
 def package_dir():
@@ -75,7 +93,7 @@ def doc_path():
 
 
 def run_lint(pkg_dir=None, targets=None, run_source=True, run_graph=True,
-             doc=None, checks=None):
+             run_dataflow=None, doc=None, checks=None):
     """Run the requested tiers; returns a
     :class:`~pystella_tpu.lint.report.LintReport`.
 
@@ -83,10 +101,18 @@ def run_lint(pkg_dir=None, targets=None, run_source=True, run_graph=True,
         installed ``pystella_tpu``).
     :arg targets: :class:`GraphTarget` list for the IR tier (default:
         :func:`pystella_tpu.lint.targets.default_targets`).
+    :arg run_dataflow: run the dataflow tier (precision-flow + static
+        comm model) over the same lowered artifacts. Default
+        (``None``): follows ``run_graph`` — drivers that skip the IR
+        tier and audit their own artifacts (``bench.py --smoke``) skip
+        it here too.
     :arg doc: path for the env-var doc-coverage check (default: the
         in-repo ``doc/observability.md`` when linting the real
         package).
     """
+    import time as _time
+    if run_dataflow is None:
+        run_dataflow = run_graph
     rep = LintReport()
     if run_source:
         if pkg_dir is None:
@@ -104,14 +130,43 @@ def run_lint(pkg_dir=None, targets=None, run_source=True, run_graph=True,
         for name in ran:
             if checks is None or name in checks:
                 rep.add_check(name)
-    if run_graph:
+    if run_graph or run_dataflow:
         if targets is None:
             from pystella_tpu.lint.targets import default_targets
             targets = default_targets()
-        violations, graph_stats, donation = graph.audit_targets(targets)
-        rep.extend(violations)
-        rep.graph = graph_stats
-        rep.donation = donation
-        for name in GRAPH_CHECKS:
-            rep.add_check(name)
+        # one build/lower/compile per target per RUN: the IR-tier
+        # audits and the dataflow tier share the same cached artifacts
+        cache = graph.ArtifactCache()
+        t0 = _time.perf_counter()
+        if run_graph:
+            violations, graph_stats, donation = graph.audit_targets(
+                targets, cache=cache)
+            rep.extend(violations)
+            rep.graph = graph_stats
+            rep.donation = donation
+            for name in GRAPH_CHECKS:
+                rep.add_check(name)
+        if run_dataflow:
+            violations, df_stats = dataflow.audit_dataflow_targets(
+                targets, cache=cache)
+            rep.extend(violations)
+            for tname, stats in df_stats.items():
+                g = rep.graph.setdefault(tname, {})
+                audits = stats.pop("timing_audits", None)
+                g.update(stats)
+                if audits:
+                    tm = g.setdefault("timing",
+                                      {"audits": {}, "total_s": 0.0})
+                    tm.setdefault("audits", {}).update(audits)
+                    tm["total_s"] = round(
+                        tm.get("total_s", 0.0)
+                        + sum(audits.values()), 4)
+            for name in DATAFLOW_CHECKS:
+                rep.add_check(name)
+        rep.timing = {
+            "targets": {
+                tname: (stats.get("timing") or {}).get("total_s")
+                for tname, stats in rep.graph.items()},
+            "total_s": round(_time.perf_counter() - t0, 4),
+            "cache": cache.stats()}
     return rep

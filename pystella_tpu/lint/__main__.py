@@ -42,7 +42,13 @@ def _force_platform():
 
 def _load_targets(spec):
     """``module:attr`` -> the target list (attr may be a list or a
-    zero-arg callable returning one)."""
+    zero-arg callable returning one); a spec WITHOUT ``:`` is a
+    comma-separated list of default-target names (``step_generic,
+    mg_smooth``) resolved by ``targets.targets_by_name``."""
+    if ":" not in spec:
+        from pystella_tpu.lint.targets import targets_by_name
+        names = [n.strip() for n in spec.split(",") if n.strip()]
+        return list(targets_by_name(names).values())
     modname, _, attr = spec.partition(":")
     mod = importlib.import_module(modname)
     obj = getattr(mod, attr or "TARGETS")
@@ -63,14 +69,20 @@ def main(argv=None):
     p.add_argument("--package", default=None, metavar="DIR",
                    help="package directory for the source tier "
                         "(default: the installed pystella_tpu)")
-    p.add_argument("--targets", default=None, metavar="MOD:ATTR",
-                   help="import spec for the IR-tier target list "
-                        "(default: pystella_tpu.lint.targets:"
-                        "default_targets)")
+    p.add_argument("--targets", default=None, metavar="NAMES|MOD:ATTR",
+                   help="comma-separated default-target names "
+                        "(step_generic,mg_smooth) or a MOD:ATTR import "
+                        "spec for a custom target list (default: "
+                        "pystella_tpu.lint.targets:default_targets)")
     p.add_argument("--no-graph", action="store_true",
-                   help="skip the IR tier (no jax needed then)")
+                   help="skip the IR + dataflow tiers (no jax needed "
+                        "then)")
     p.add_argument("--no-source", action="store_true",
                    help="skip the source tier")
+    p.add_argument("--no-dataflow", action="store_true",
+                   help="skip the dataflow tier (precision-flow + "
+                        "static comm model); the IR-tier allow-set "
+                        "audits still run")
     p.add_argument("--json", action="store_true",
                    help="print the full report JSON to stdout instead "
                         "of the text summary")
@@ -88,11 +100,17 @@ def main(argv=None):
 
     targets = None
     if args.targets:
-        targets = _load_targets(args.targets)
+        try:
+            targets = _load_targets(args.targets)
+        except KeyError as e:
+            print(f"lint: {e.args[0] if e.args else e}",
+                  file=sys.stderr)
+            return 2
 
     rep = lint.run_lint(
         pkg_dir=args.package, targets=targets,
-        run_source=not args.no_source, run_graph=not args.no_graph)
+        run_source=not args.no_source, run_graph=not args.no_graph,
+        run_dataflow=not (args.no_graph or args.no_dataflow))
 
     out_dir = args.out
     if out_dir is None:

@@ -43,14 +43,15 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import time
 
 from pystella_tpu.lint.report import Violation
 
 __all__ = ["POLICY_F32", "POLICY_F64", "POLICY_BF16_ACC32",
            "POLICY_SPECTRAL_F32",
-           "GraphTarget", "audit_artifacts", "audit_target",
-           "audit_targets", "lower_and_compile", "parse_main_params",
-           "tensor_nbytes"]
+           "ArtifactCache", "GraphTarget", "audit_artifacts",
+           "audit_target", "audit_targets", "lower_and_compile",
+           "parse_main_params", "tensor_nbytes"]
 
 #: bytes per MLIR tensor element type
 _ELT_BYTES = {
@@ -401,61 +402,137 @@ def lower_and_compile(fn, args=(), kwargs=None):
     return asm, hlo_text
 
 
+class ArtifactCache:
+    """Per-lint-run cache of built/lowered/compiled target artifacts.
+
+    Each target's ``build()`` + ``lower()`` + ``compile()`` — by far
+    the dominant lint cost — runs ONCE per run; the IR-tier audits and
+    the dataflow tier (:mod:`pystella_tpu.lint.dataflow`) then share
+    one ``{asm, hlo_text, donatable_bytes, build_s}`` record through
+    :meth:`get`. Build failures are remembered too (``failed``), so a
+    broken target is reported once and never rebuilt within a run.
+    ``stats()`` — ``{"builds", "hits"}`` — lands in the report summary
+    so the sharing is auditable.
+    """
+
+    def __init__(self):
+        self._arts = {}
+        self.failed = {}
+        self.builds = 0
+        self.hits = 0
+
+    def get(self, target):
+        """The artifact record for ``target`` (building on first use).
+        Re-raises the remembered error for a target that already
+        failed to build this run."""
+        name = target.name
+        if name in self._arts:
+            self.hits += 1
+            return self._arts[name]
+        if name in self.failed:
+            self.hits += 1
+            raise RuntimeError(self.failed[name])
+        t0 = time.perf_counter()
+        try:
+            fn, args, kwargs, donatable = target.build()
+            asm, hlo_text = lower_and_compile(fn, args, kwargs)
+        except Exception as e:  # noqa: BLE001 — remembered for the caller
+            self.failed[name] = f"{type(e).__name__}: {e}"
+            self.builds += 1
+            raise
+        self.builds += 1
+        art = {"asm": asm, "hlo_text": hlo_text,
+               "donatable_bytes": (None if donatable is None
+                                   else _nbytes_of(donatable)),
+               "build_s": round(time.perf_counter() - t0, 4)}
+        self._arts[name] = art
+        return art
+
+    def stats(self):
+        return {"builds": self.builds, "hits": self.hits}
+
+
 def audit_artifacts(name, asm, hlo_text, donatable_bytes=None,
                     dtype_policy=None, collectives=None,
-                    fused_scopes=()):
-    """Run every audit over already-lowered artifacts; returns
+                    fused_scopes=(), timings=None):
+    """Run every IR-tier audit over already-lowered artifacts; returns
     ``(violations, stats)``. This is also the entry point for drivers
     that audit the executable they are about to dispatch
-    (``bench.py --smoke``)."""
+    (``bench.py --smoke``). ``timings``, when given a dict, is filled
+    with per-audit wall seconds keyed by checker name."""
     violations = []
     stats = {"built": True}
+
+    def run(label, fn, *a, **k):
+        t0 = time.perf_counter()
+        out = fn(*a, **k)
+        if timings is not None:
+            timings[label] = round(time.perf_counter() - t0, 4)
+        return out
+
     if donatable_bytes is not None:
-        v, stats["donation"] = audit_donation(name, asm, donatable_bytes)
+        v, stats["donation"] = run("donation", audit_donation,
+                                   name, asm, donatable_bytes)
         violations += v
-    v, stats["dtype"] = audit_dtypes(name, asm, dtype_policy)
+    v, stats["dtype"] = run("dtype", audit_dtypes, name, asm,
+                            dtype_policy)
     violations += v
-    v, stats["collectives"] = audit_collectives(
-        name, hlo_text, collectives or {})
+    v, stats["collectives"] = run("collectives", audit_collectives,
+                                  name, hlo_text, collectives or {})
     violations += v
-    v, stats["host"] = audit_host(name, asm, hlo_text)
+    v, stats["host"] = run("host", audit_host, name, asm, hlo_text)
     violations += v
     if fused_scopes:
-        v, stats["fusion"] = audit_fusion(name, asm, fused_scopes)
+        v, stats["fusion"] = run("fusion", audit_fusion, name, asm,
+                                 fused_scopes)
         violations += v
     return violations, stats
 
 
-def audit_target(target):
-    """Build, lower, compile and audit one target; returns
-    ``(violations, stats)``. Build/compile failures surface as an
-    ``error`` violation rather than killing the whole lint run."""
+def audit_target(target, cache=None):
+    """Build, lower, compile and audit one target (through ``cache``
+    when given — see :class:`ArtifactCache`); returns ``(violations,
+    stats)``. Build/compile failures surface as an ``error`` violation
+    rather than killing the whole lint run. ``stats["timing"]`` records
+    the build and per-audit wall seconds."""
+    if cache is None:
+        cache = ArtifactCache()
+    t_start = time.perf_counter()
     try:
-        fn, args, kwargs, donatable = target.build()
-        asm, hlo_text = lower_and_compile(fn, args, kwargs)
+        art = cache.get(target)
     except Exception as e:  # noqa: BLE001 — any build failure is a finding
         return [Violation(
             checker="graph-build", where=target.name,
             message=f"target failed to build/lower/compile: "
                     f"{type(e).__name__}: {e}")], {"built": False}
-    return audit_artifacts(
-        target.name, asm, hlo_text,
-        donatable_bytes=(None if donatable is None
-                         else _nbytes_of(donatable)),
+    timings = {}
+    violations, stats = audit_artifacts(
+        target.name, art["asm"], art["hlo_text"],
+        donatable_bytes=art["donatable_bytes"],
         dtype_policy=target.dtype_policy,
         collectives=target.collectives,
-        fused_scopes=target.fused_scopes)
+        fused_scopes=target.fused_scopes,
+        timings=timings)
+    stats["timing"] = {
+        "build_s": art["build_s"],
+        "audits": timings,
+        "total_s": round(time.perf_counter() - t_start, 4)}
+    return violations, stats
 
 
-def audit_targets(targets):
+def audit_targets(targets, cache=None):
     """Audit a list of targets; returns ``(violations, graph_stats,
     donation_summary)`` where ``donation_summary`` aggregates coverage
-    across every target that declared donatable state."""
+    across every target that declared donatable state. Pass a shared
+    :class:`ArtifactCache` so a following dataflow tier reuses the
+    same lowered/compiled modules."""
     violations = []
     graph = {}
     donatable = aliased = 0
+    if cache is None:
+        cache = ArtifactCache()
     for t in targets:
-        v, stats = audit_target(t)
+        v, stats = audit_target(t, cache=cache)
         violations += v
         graph[t.name] = stats
         don = stats.get("donation")

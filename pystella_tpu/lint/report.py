@@ -22,6 +22,11 @@ Schema (v1)::
           "coverage_pct": <float>,   # 100 * aliased / donatable
           "wasted_bytes": <int>,     # the HBM cost of the misses
         },
+        "timing": {                  # IR/dataflow tiers, absent without
+          "targets": {<name>: <s>},  # per-target wall seconds
+          "total_s": <float>,
+          "cache": {"builds": <int>, "hits": <int>},
+        },
       },
       "violations": [
         {"checker": ..., "severity": "error"|"warning",
@@ -84,6 +89,10 @@ class LintReport:
     graph: dict = dataclasses.field(default_factory=dict)
     source: dict = dataclasses.field(default_factory=dict)
     donation: dict | None = None
+    #: lint-run wall-time accounting: ``{"targets": {name: seconds},
+    #: "total_s": float, "cache": {"builds": int, "hits": int}}`` —
+    #: per-audit splits live under each target's ``graph`` stats
+    timing: dict | None = None
     generated_ts: float | None = None
 
     def extend(self, violations):
@@ -111,6 +120,8 @@ class LintReport:
         }
         if self.donation is not None:
             s["donation"] = dict(self.donation)
+        if self.timing is not None:
+            s["timing"] = dict(self.timing)
         return s
 
     def to_dict(self):
@@ -138,6 +149,7 @@ class LintReport:
             graph=dict(d.get("graph") or {}),
             source=dict(d.get("source") or {}),
             donation=(d.get("summary") or {}).get("donation"),
+            timing=(d.get("summary") or {}).get("timing"),
             generated_ts=d.get("generated_ts"),
         )
         return rep
@@ -162,6 +174,15 @@ class LintReport:
                  f"checks: {', '.join(s['checks']) or '(none)'}"]
         if s.get("targets"):
             lines.append("graph targets: " + ", ".join(s["targets"]))
+        tm = s.get("timing")
+        if tm and tm.get("total_s") is not None:
+            cache = tm.get("cache") or {}
+            lines.append(
+                f"lint wall time: {tm['total_s']:.2f}s over "
+                f"{len(tm.get('targets') or {})} target(s)"
+                + (f" (artifact cache: {cache.get('builds', 0)} "
+                   f"build(s), {cache.get('hits', 0)} reuse(s))"
+                   if cache else ""))
         don = s.get("donation")
         if don:
             lines.append(

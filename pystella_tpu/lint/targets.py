@@ -18,8 +18,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from pystella_tpu.lint.graph import (POLICY_F32, POLICY_SPECTRAL_F32,
-                                     GraphTarget)
+from pystella_tpu.lint.graph import (POLICY_BF16_ACC32, POLICY_F32,
+                                     POLICY_SPECTRAL_F32, GraphTarget)
 
 __all__ = ["default_targets", "targets_by_name", "GRID"]
 
@@ -178,6 +178,48 @@ def build_chunk_multi_step():
     if stepper._chunk_call is None:
         raise RuntimeError("chunk kernel failed to build at the audit "
                            "shape — the fallback warning says why")
+    rng = np.random.default_rng(11)
+    state = {
+        "f": decomp.shard(
+            1e-3 * rng.standard_normal((2,) + GRID).astype(np.float32)),
+        "dfdt": decomp.shard(
+            1e-4 * rng.standard_normal((2,) + GRID).astype(np.float32)),
+    }
+    fn = stepper._multi_jit(2)
+    args = (state,)
+    kwargs = {"t": np.float32(0.0), "dt": np.float32(0.01),
+              "rhs_args": {"a": np.float32(1.0),
+                           "hubble": np.float32(0.5)},
+              "rhs_seq": {}}
+    return fn, args, kwargs, state
+
+
+def build_bf16_chunk_multi_step():
+    """The ROADMAP mixed-precision production tier's chunk program:
+    ``carry_dtype=bf16`` keeps the RK carries (``kf``/``kdfdt``) in
+    bf16 between stages while state and every accumulation stay f32.
+    Audited under ``POLICY_BF16_ACC32`` — the dataflow tier must see
+    every f32->bf16 narrowing under the registered ``carry_quantize``
+    scope (ops/fused.py ``CARRY_SCOPE``) and no bf16 on any
+    accumulation chain; this is the flow property the set-based dtype
+    check cannot express (bf16 AND f32 are both in the allow-set)."""
+    import jax.numpy as jnp
+    import pystella_tpu as ps
+    decomp = _mesh_decomp(want_sharded=False)
+    lattice = ps.Lattice(GRID, (5.0, 5.0, 5.0), dtype=np.float32)
+
+    def potential(f):
+        return 0.5 * 1.2e-2 * f[0] ** 2 + 0.125 * f[0] ** 2 * f[1] ** 2
+
+    sector = ps.ScalarSector(2, potential=potential)
+    stepper = ps.FusedScalarStepper(
+        sector, decomp, GRID, lattice.dx, 2, dtype=jnp.float32,
+        carry_dtype=jnp.bfloat16, chunk_stages=4, chunk_bx=4,
+        chunk_by=8, autotune=False)
+    if stepper._chunk_call is None:
+        raise RuntimeError("bf16-carry chunk kernel failed to build at "
+                           "the audit shape — the fallback warning "
+                           "says why")
     rng = np.random.default_rng(11)
     state = {
         "f": decomp.shard(
@@ -360,6 +402,17 @@ def default_targets():
             build=build_chunk_multi_step,
             dtype_policy=POLICY_F32,
             collectives={},
+            fused_scopes=("chunk_stage",),
+        ),
+        GraphTarget(
+            name="bf16_chunk_multi_step",
+            build=build_bf16_chunk_multi_step,
+            dtype_policy=POLICY_BF16_ACC32,
+            collectives={},
+            # carry_quantize itself is NOT listed: interpret-mode
+            # lowering erases in-kernel name stacks, so the carry casts
+            # carry the chunk_stage/pallas_stencil dispatch path — the
+            # dataflow tier's kernel_converts stat pins them instead
             fused_scopes=("chunk_stage",),
         ),
         GraphTarget(

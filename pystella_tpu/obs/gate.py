@@ -30,7 +30,12 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       coverage-loss warning) — or a FLEET SLO regression: the
       ``fleet`` section's aggregated queue-p95 or warm-TTFS exceeds
       the baseline's by both the configured factor and floor
-      (``--no-fleet`` opts out)
+      (``--no-fleet`` opts out) — or a COMM EXCESS: a ``comm`` leg's
+      measured collective traffic exceeds the dataflow lint tier's
+      static model by more than ``comm_excess_pct`` (the model is an
+      upper bound on what the program's collectives can move per
+      invocation; measured above it means traffic the model does not
+      attribute — ``--no-comm`` opts out)
 2     invalid evidence: the contamination detector flagged the run
       (outlier burst / bimodal step times — the round-5 concurrent-probe
       signature), the report has no step samples, the run DIVERGED (a
@@ -52,7 +57,11 @@ Exit codes (CI and the armed-hardware-revalidation scripts key on them):
       coverage while its own scrape record shows lost replicas or
       failed scrapes (fleet aggregates over the survivors are partial
       evidence; an HONESTLY-partial fleet record is annotated
-      degraded instead), or baseline and current were measured on
+      degraded instead), the report's ``comm`` section claims
+      modeled-vs-measured coverage (``covered: true``) while no leg
+      actually carries a static model (a coverage claim with nothing
+      behind it — the dataflow lint tier never ran, or the section
+      was assembled by hand), or baseline and current were measured on
       different hardware. Exception: a
       run that recorded AND recovered REAL (non-harness-injected)
       incidents (``resilience`` section,
@@ -95,6 +104,7 @@ import argparse
 import json
 import sys
 
+from pystella_tpu import config as _config
 from pystella_tpu.obs import events as _events
 from pystella_tpu.obs.ledger import mad as _mad
 from pystella_tpu.obs.ledger import percentile as _percentile
@@ -232,6 +242,7 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
                     check_ensemble=True, ensemble_threshold_pct=20.0,
                     check_resilience=True,
                     check_fft=True, fft_threshold_pct=25.0,
+                    check_comm=True, comm_excess_pct=25.0,
                     check_service=True, service_queue_factor=2.5,
                     service_queue_floor_s=0.5,
                     service_ttfs_factor=2.5,
@@ -824,6 +835,9 @@ def compare_reports(baseline, current, threshold_pct=10.0, mad_k=3.0,
     if check_fft:
         _compare_fft(verdict, baseline, current,
                      threshold_pct=fft_threshold_pct)
+    if check_comm:
+        _check_comm(verdict, baseline, current,
+                    excess_pct=comm_excess_pct)
     if check_service:
         _compare_service(verdict, baseline, current,
                          queue_factor=service_queue_factor,
@@ -1057,6 +1071,81 @@ def _compare_fft(verdict, baseline, current, threshold_pct=25.0):
         verdict["warnings"].append(
             f"fft improvement: spectra p50 {-slow_pct:.1f}% below "
             "baseline — consider refreshing the baseline")
+
+
+def _check_comm(verdict, baseline, current, excess_pct=25.0):
+    """Modeled-vs-measured communication check (mutates ``verdict``
+    in place) over the current report's ``comm`` section — the
+    ledger's join of the dataflow lint tier's static comm model
+    against the run's measured collective traffic.
+
+    Three verdicts. A leg whose measured bytes exceed its modeled
+    bytes by more than ``excess_pct`` fails (exit 1): the model counts
+    every collective the compiled program CAN issue per invocation, so
+    measured traffic above it is traffic the model does not attribute
+    — an extra collective the partitioner materialized after the
+    audit, or a byte counter measuring a different program than the
+    one modeled. A ``comm`` section claiming ``covered: true`` while
+    no leg carries a static model is refused (exit 2): coverage means
+    modeled AND measured sides joined, so the claim is unsupportable —
+    the dataflow tier never ran, or the section was assembled by hand.
+    Coverage loss (baseline's comm was covered, current's is absent or
+    uncovered) degrades to a warning, like every lost-coverage
+    pattern here. Reports predating the section (no ``comm`` key and
+    no claim) pass through untouched."""
+    ccm = current.get("comm")
+    bcm = (baseline or {}).get("comm") or {}
+    if not ccm:
+        if bcm.get("covered"):
+            verdict["warnings"].append(
+                "comm: baseline carried a covered modeled-vs-measured "
+                "comm section but the current run has none — "
+                "communication coverage was lost")
+        return
+    legs = ccm.get("legs") or []
+    modeled_legs = [leg for leg in legs
+                    if isinstance(leg.get("modeled_bytes"), (int, float))
+                    and leg["modeled_bytes"] > 0]
+    if ccm.get("covered") and not modeled_legs:
+        verdict.update(ok=False, exit_code=2)
+        verdict["reasons"].append(
+            "invalid_evidence: report claims modeled-vs-measured comm "
+            "coverage (comm.covered) but no leg carries a static "
+            "model — a coverage claim with no model behind it; run "
+            "the dataflow lint tier (python -m pystella_tpu.lint) or "
+            "drop the claim")
+        return
+    checked = []
+    for leg in modeled_legs:
+        meas = leg.get("measured_bytes")
+        if not isinstance(meas, (int, float)):
+            continue
+        modeled = float(leg["modeled_bytes"])
+        over = 100.0 * (meas / modeled - 1.0)
+        checked.append({
+            "target": leg.get("target"), "class": leg.get("class"),
+            "modeled_bytes": modeled, "measured_bytes": float(meas),
+            "excess_pct": over,
+        })
+        if over > excess_pct:
+            verdict.update(ok=False,
+                           exit_code=max(verdict["exit_code"], 1))
+            verdict["reasons"].append(
+                f"comm excess: {leg.get('target')} "
+                f"({leg.get('class')}) measured {meas:,.0f} B per "
+                f"invocation is {over:.1f}% above the static model's "
+                f"{modeled:,.0f} B (threshold {excess_pct:g}%) — "
+                "collective traffic the model does not attribute; "
+                "re-audit the program or find the unmodeled "
+                "collective")
+    if checked:
+        verdict["comm"] = {"legs": checked,
+                           "excess_threshold_pct": excess_pct}
+    if bcm.get("covered") and not ccm.get("covered"):
+        verdict["warnings"].append(
+            "comm: baseline's comm section was covered (modeled and "
+            "measured joined) but the current run's is not — "
+            "communication coverage was lost")
 
 
 def _compare_service(verdict, baseline, current, queue_factor=2.5,
@@ -1553,6 +1642,16 @@ def main(argv=None):
     p.add_argument("--no-fft", action="store_true",
                    help="skip the spectral-tier (fft section) "
                         "spectra-throughput check")
+    p.add_argument("--comm-excess-pct", type=float,
+                   default=_config.get_float(
+                       "PYSTELLA_GATE_COMM_EXCESS_PCT"),
+                   help="comm: allowed measured-over-modeled collective"
+                        "-traffic excess before the gate fails "
+                        "(default 25, env "
+                        "PYSTELLA_GATE_COMM_EXCESS_PCT)")
+    p.add_argument("--no-comm", action="store_true",
+                   help="skip the modeled-vs-measured communication "
+                        "check (comm section)")
     p.add_argument("--service-queue-factor", type=float, default=2.5,
                    help="service: allowed multiple of the baseline's "
                         "queue-latency p95 before the gate fails "
@@ -1686,6 +1785,8 @@ def main(argv=None):
         check_resilience=not args.no_resilience,
         check_fft=not args.no_fft,
         fft_threshold_pct=args.fft_threshold_pct,
+        check_comm=not args.no_comm,
+        comm_excess_pct=args.comm_excess_pct,
         check_service=not args.no_service,
         service_queue_factor=args.service_queue_factor,
         service_queue_floor_s=args.service_queue_floor,

@@ -740,6 +740,73 @@ class PerfLedger:
             "achieved_ici_gbps": ici,
         }
 
+    def comm(self):
+        """Modeled-vs-measured communication: joins the lint event's
+        static comm model (``static_comm`` — per-target per-invocation
+        collective bytes the dataflow lint tier classified as halo /
+        transpose / scalar from the compiled HLO) against the traffic
+        the run actually measured. The halo leg pairs the
+        ``smoke_overlap`` model with the ``halo_traffic`` event
+        (``decomp.traced_halo_bytes()`` — the per-device ICI bytes one
+        overlapped call moves, the same per-invocation unit the model
+        counts); targets the run has no byte counter for stay
+        model-only rows. ``covered`` is True only when at least one
+        leg has BOTH sides — the gate refuses a report that claims
+        coverage without a model. ``None`` when the run carried
+        neither a model nor a measured counter."""
+        model = (self.lint or {}).get("static_comm") or {}
+        calls = (self.scopes or {}).get("halo_overlap") or {}
+        measured = {}
+        if self.halo_bytes_per_step:
+            measured["smoke_overlap"] = {
+                "bytes": float(self.halo_bytes_per_step),
+                "class": "halo",
+                "source": "halo_traffic",
+                "calls": calls.get("count"),
+            }
+        if not model and not measured:
+            return None
+        legs = []
+        for target in sorted(set(model) | set(measured)):
+            block = model.get(target) or {}
+            per_inv = block.get("per_invocation_bytes") or {}
+            total = (block.get("total_bytes")
+                     if block.get("modeled") else None)
+            meas = measured.get(target)
+            cls = meas["class"] if meas else (
+                max(per_inv, key=per_inv.get) if per_inv else None)
+            # compare like against like: a measured halo counter joins
+            # the model's halo class, not the program's total (which
+            # may also carry scalar all-reduces)
+            modeled = per_inv.get(cls, total) if cls else total
+            leg = {
+                "target": target,
+                "class": cls,
+                "modeled_bytes": modeled,
+                "modeled_total_bytes": total,
+                "modeled_classes": per_inv or None,
+                "measured_bytes": meas["bytes"] if meas else None,
+                "measured_source": meas["source"] if meas else None,
+                "calls": meas["calls"] if meas else None,
+                "excess_pct": None,
+                "within": None,
+            }
+            if meas and modeled:
+                leg["excess_pct"] = round(
+                    (meas["bytes"] / modeled - 1.0) * 100.0, 2)
+                # 25% is the gate's default excess threshold
+                # (PYSTELLA_GATE_COMM_EXCESS_PCT); recorded here so
+                # the markdown can flag a leg without re-deriving it
+                leg["within"] = leg["excess_pct"] <= 25.0
+            legs.append(leg)
+        return {
+            "covered": any(leg["modeled_bytes"] and leg["measured_bytes"]
+                           for leg in legs),
+            "legs": legs,
+            "halo_bytes_exchanged":
+                self.metrics.get("halo_bytes_exchanged"),
+        }
+
     def cold_start(self):
         """The cold-start summary: time-to-first-step breakdown (from
         the driver's ``cold_start`` event), the per-program compile
@@ -1568,6 +1635,7 @@ class PerfLedger:
             },
             "roofline": self.roofline(),
             "overlap": self.overlap_summary(),
+            "comm": self.comm(),
             "cold_start": self.cold_start(),
             "numerics": self.numerics(),
             "ensemble": self.ensemble(),
@@ -1807,6 +1875,27 @@ def render_markdown(rep):
                 f"overlapped call(s) -> achieved "
                 f"~{_fmt(ov.get('achieved_ici_gbps'))} GB/s ICI "
                 "(per-device estimate)")
+        lines.append("")
+    cm = rep.get("comm")
+    if cm:
+        lines += ["## Modeled vs measured communication", ""]
+        for leg in cm.get("legs") or []:
+            row = (f"- {leg.get('target')} ({leg.get('class') or '—'}): "
+                   f"modeled {_fmt(leg.get('modeled_bytes'), ',.0f')} B")
+            if leg.get("measured_bytes") is not None:
+                row += (f", measured "
+                        f"{_fmt(leg.get('measured_bytes'), ',.0f')} B "
+                        f"({leg.get('measured_source')}) -> "
+                        f"{_fmt(leg.get('excess_pct'), '+.1f')}% vs "
+                        f"model"
+                        + ("" if leg.get("within") in (None, True)
+                           else " **EXCESS**"))
+            else:
+                row += " (model-only: no measured counter this run)"
+            lines.append(row)
+        if not cm.get("covered"):
+            lines.append("- *(no leg carries both a model and a "
+                         "measured counter — comm not covered)*")
         lines.append("")
     cs = rep.get("cold_start")
     if cs:

@@ -82,6 +82,11 @@ for _name in (
     # the whole-RK-chunk (temporal blocking) kernel dispatch and the
     # persistent autotuner's timed candidate probes (ops.autotune)
     "chunk_stage", "autotune_probe",
+    # the sanctioned carry_dtype quantization point (ops.fused): the one
+    # scope under which an f32->bf16 narrowing is legal; the dataflow
+    # lint tier treats any float downcast OUTSIDE this scope as a
+    # POLICY_BF16_ACC32 violation
+    "carry_quantize",
     # multigrid
     "mg_cycle", "mg_smooth", "mg_residual",
     # driver-level spans (bench smoke / example loops)

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from pystella_tpu import config as _config
@@ -53,7 +54,37 @@ from pystella_tpu.ops.pallas_stencil import (
     grad_from_taps as _grad_from_taps, lap_from_taps as _lap_from_taps,
 )
 
-__all__ = ["FusedScalarStepper", "FusedPreheatStepper"]
+__all__ = ["FusedScalarStepper", "FusedPreheatStepper", "CARRY_SCOPE"]
+
+#: The registered carry-quantization point. Every ``carry_dtype`` downcast
+#: the steppers emit is wrapped in this named scope, so the dataflow lint
+#: tier (``pystella_tpu.lint.dataflow``) can tell a sanctioned RK-carry
+#: quantization from an accidental mid-chain precision loss: a float
+#: narrowing whose HLO scope path does not pass through this scope is a
+#: POLICY_BF16_ACC32 violation.
+CARRY_SCOPE = "carry_quantize"
+
+
+def _carry_cast(x, dtype):
+    """The ONE sanctioned narrowing: cast ``x`` to the carry dtype
+    under the :data:`CARRY_SCOPE` named scope, so the lowered module's
+    convert carries the scope path the dataflow lint tier keys on."""
+    with jax.named_scope(CARRY_SCOPE):
+        return x.astype(dtype)
+
+
+def _quantize_carries(body, dtypes):
+    """Wrap a stage ``body`` so its carry-named outputs are cast to the
+    carry dtype via :func:`_carry_cast`. The stencil kernel's own
+    ``astype(ref.dtype)`` on store then becomes an identity, and every
+    f32->bf16 convert in the lowered module is scope-annotated."""
+    def wrapped(taps, extras, scalars):
+        outs = dict(body(taps, extras, scalars))
+        for n, dt in dtypes.items():
+            if n in outs:
+                outs[n] = _carry_cast(outs[n], dt)
+        return outs
+    return wrapped
 
 
 class FusedScalarStepper(_step.Stepper):
@@ -320,6 +351,10 @@ class FusedScalarStepper(_step.Stepper):
             names = (set(win_defs) | set(extra_defs or {})
                      | set(out_defs)) & self._carry_names
             dtypes = {n: self._carry_dtype for n in names}
+            out_carries = set(out_defs) & self._carry_names
+            if out_carries:
+                body = _quantize_carries(
+                    body, {n: self._carry_dtype for n in out_carries})
         bx, by, source = self._resolve_blocks(kind, bx, by, stages)
         common = dict(extra_defs=extra_defs, scalar_names=scalar_names,
                       dtype=self.dtype, sum_defs=sum_defs, dtypes=dtypes)
@@ -681,9 +716,11 @@ class FusedScalarStepper(_step.Stepper):
                 scalars[f"A{i}"], scalars[f"B{i}"])
             if cd is not None and j % 2 == 1 and j < depth - 1:
                 tkf = self._memo_taps(
-                    lambda sx, sy, t=tkf: t(sx, sy).astype(cd), roll)
+                    lambda sx, sy, t=tkf: _carry_cast(t(sx, sy), cd),
+                    roll)
                 tkdf = self._memo_taps(
-                    lambda sx, sy, t=tkdf: t(sx, sy).astype(cd), roll)
+                    lambda sx, sy, t=tkdf: _carry_cast(t(sx, sy), cd),
+                    roll)
         return {"f": tf(), "dfdt": tdf(), "kf": tkf(), "kdfdt": tkdf()}
 
     def _chunk_fallback(self, reason):

@@ -4,11 +4,16 @@
         --targets lint_fixture_targets:TARGETS
 
 Each target lowers a tiny synthetic computation carrying exactly one
-hazard the graph audits must name: an un-donated fake step, a silent
-f64 upcast, and a host callback on the "step" path.
+hazard the audits must name: an un-donated fake step, a silent f64
+upcast, a host callback on the "step" path, a mid-chain f32->bf16
+downcast outside any registered carry point (the dataflow tier's
+precision-flow rule 1), and a field-sized all-gather whose base op is
+ALLOWLISTED — only the static comm model's by-bytes replication check
+catches it.
 """
 
-from pystella_tpu.lint.graph import POLICY_F32, GraphTarget
+from pystella_tpu.lint.graph import (POLICY_BF16_ACC32, POLICY_F32,
+                                     GraphTarget)
 
 
 def build_undonated_step():
@@ -43,6 +48,50 @@ def build_callback_step():
     return jax.jit(f), (jnp.ones(8, jnp.float32),), {}, None
 
 
+def build_bf16_downcast_step():
+    """A mid-chain f32->bf16 downcast under a plain (non-carry) named
+    scope — legal by POLICY_BF16_ACC32's allow-SET (bf16 and f32 both
+    allowed), illegal as a FLOW (the narrowing is not at a registered
+    carry point); the precision-flow violation must name the
+    ``rk_carry_math`` scope path."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((32, 32), jnp.float32)
+
+    def f(v):
+        with jax.named_scope("rk_carry_math"):
+            y = v * 2.0
+            c = y.astype(jnp.bfloat16)      # the seeded hazard
+        return c.astype(jnp.float32) + 1.0
+
+    return jax.jit(f), (x,), {}, None
+
+
+def build_replicating_gather():
+    """A sharding constraint that forces the partitioner to all-gather
+    a full field onto every device. The base op is allowlisted in the
+    target (collective-set check passes), so ONLY the static comm
+    model's by-bytes classification — result >= half the largest
+    module parameter — reports the replication."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    ndev = min(4, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("x",))
+    x = jax.device_put(jnp.ones((32, 32, 32), jnp.float32),
+                       NamedSharding(mesh, P("x")))
+
+    def f(v):
+        with jax.named_scope("replicate_field"):
+            g = jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P()))
+        return (g * 2.0).sum()
+
+    return jax.jit(f), (x,), {}, None
+
+
 TARGETS = [
     GraphTarget(name="undonated_step", build=build_undonated_step,
                 dtype_policy=POLICY_F32),
@@ -50,4 +99,13 @@ TARGETS = [
                 dtype_policy=POLICY_F32),
     GraphTarget(name="callback_step", build=build_callback_step,
                 dtype_policy=POLICY_F32),
+    GraphTarget(name="bf16_downcast_step",
+                build=build_bf16_downcast_step,
+                dtype_policy=POLICY_BF16_ACC32),
+    GraphTarget(name="replicating_gather",
+                build=build_replicating_gather,
+                dtype_policy=POLICY_F32,
+                collectives={"all-gather": "deliberately allowlisted: "
+                             "the by-bytes replication check must fire "
+                             "anyway"}),
 ]

@@ -556,6 +556,55 @@ def test_gate_warns_tpu_report_without_autotune_table():
     assert not any("autotune-coverage" in w for w in v["warnings"])
 
 
+def test_ledger_comm_join_from_events(tmp_path):
+    """The modeled-vs-measured comm join, from synthetic events: the
+    lint event's static_comm block supplies the model, halo_traffic
+    the measured side, and the ledger pairs them class-against-class
+    (the halo counter joins the model's halo class, not the program
+    total that also carries scalar all-reduces)."""
+    path = str(tmp_path / "run.jsonl")
+    with events.EventLog(path) as log:
+        log.emit("bench_run", grid_shape=[16, 16, 16], nsteps=4)
+        for ms in (2.0, 2.1):
+            log.emit("step_time", ms=ms)
+        log.emit("trace_summary", scopes={
+            "halo_overlap": {"count": 6, "total_ms": 3.0}})
+        log.emit("halo_traffic", bytes_per_step=5120)
+        log.emit("lint", ok=True, static_comm={
+            "smoke_overlap": {
+                "modeled": True, "total_bytes": 5632,
+                "per_invocation_bytes": {"halo": 5120, "scalar": 512},
+                "collectives": 3},
+            "smoke_spectra": {
+                "modeled": True, "total_bytes": 4096,
+                "per_invocation_bytes": {"transpose": 4096},
+                "collectives": 1}})
+    led = ledger.PerfLedger.from_events(path)
+    comm = led.report()["comm"]
+    assert comm["covered"] is True
+    legs = {leg["target"]: leg for leg in comm["legs"]}
+    halo = legs["smoke_overlap"]
+    # class-matched join: 5120 (halo class), not the 5632 total
+    assert halo["class"] == "halo"
+    assert halo["modeled_bytes"] == 5120
+    assert halo["modeled_total_bytes"] == 5632
+    assert halo["measured_bytes"] == 5120.0
+    assert halo["measured_source"] == "halo_traffic"
+    assert halo["calls"] == 6
+    assert halo["excess_pct"] == 0.0 and halo["within"] is True
+    # no byte counter for the spectra program: model-only row
+    spectra = legs["smoke_spectra"]
+    assert spectra["modeled_bytes"] == 4096
+    assert spectra["measured_bytes"] is None
+    assert spectra["within"] is None
+    # a run with neither model nor counter carries no comm section
+    bare = str(tmp_path / "bare.jsonl")
+    with events.EventLog(bare) as log:
+        log.emit("bench_run", grid_shape=[8, 8, 8])
+        log.emit("step_time", ms=1.0)
+    assert ledger.PerfLedger.from_events(bare).report()["comm"] is None
+
+
 # -- smoke -> gate end to end ---------------------------------------------
 
 def test_smoke_to_gate_end_to_end(tmp_path, capsys):
@@ -834,6 +883,34 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     assert "all-gather" not in coll["seen"]
     assert "all-gather" not in coll["small"]
     assert spec_stats["fusion"]["scopes"]["fft_stage"] is True
+    # the dataflow tier ran over every dispatched program: precision
+    # flow clean, and each program carries a static comm model
+    assert "precision-flow" in lint_rep["summary"]["checks"]
+    assert "static-comm" in lint_rep["summary"]["checks"]
+    assert {"smoke_step", "smoke_spectra", "smoke_overlap"} \
+        <= set(lint_rep["graph"])
+    assert lint_rep["graph"]["smoke_step"]["precision"]["ok"] is True
+    assert lint_rep["graph"]["smoke_overlap"]["static_comm"][
+        "per_invocation_bytes"].get("halo")
+    # ... and the ledger joined it against the measured traffic: the
+    # report's comm section pairs the overlap program's modeled halo
+    # bytes with the halo_traffic event's measured per-invocation ICI
+    # bytes — byte-exact at this size (both derive from the same slab
+    # shapes), so the leg is within the gate's excess threshold
+    cm = rep["comm"]
+    assert cm["covered"] is True
+    halo_leg = [leg for leg in cm["legs"]
+                if leg["target"] == "smoke_overlap"][0]
+    assert halo_leg["class"] == "halo"
+    assert halo_leg["modeled_bytes"] > 0
+    assert halo_leg["measured_bytes"] == pytest.approx(
+        halo_leg["modeled_bytes"])
+    assert halo_leg["within"] is True and halo_leg["calls"] == 6
+    spec_leg = [leg for leg in cm["legs"]
+                if leg["target"] == "smoke_spectra"][0]
+    assert spec_leg["modeled_bytes"] > 0
+    assert spec_leg["measured_bytes"] is None  # model-only row
+    assert "Modeled vs measured communication" in md
     rz_kinds = {r["kind"] for r in events.read_events(
         os.path.join(out, "smoke_events.jsonl"))}
     assert {"fault_injected", "fault_detected", "recovery_attempt",
@@ -1011,6 +1088,35 @@ def test_smoke_to_gate_end_to_end(tmp_path, capsys):
     assert burned_verdict["exit_code"] == 1
     assert any("goodput regression" in r
                for r in burned_verdict["reasons"])
+    # the comm legs on the REAL smoke report: measured halo traffic
+    # inflated >25% over the static model exits 1 naming the leg; a
+    # comm section claiming coverage with no model behind it is
+    # refused (exit 2); --no-comm opts out of both — driven in-process
+    # (same argparse -> verdict -> exit path as the subprocess runs)
+    comm_bad = json.loads(json.dumps(rep))
+    for leg in comm_bad["comm"]["legs"]:
+        if leg["target"] == "smoke_overlap":
+            leg["measured_bytes"] = leg["modeled_bytes"] * 1.5
+    comm_bad_path = str(tmp_path / "comm_excess.json")
+    json.dump(comm_bad, open(comm_bad_path, "w"))
+    assert gate.main(["--baseline", report_path, "--current",
+                      comm_bad_path, "--threshold-pct", "300"]) == 1
+    capsys.readouterr()
+    comm_verdict = gate.compare_reports(rep, comm_bad)
+    assert comm_verdict["exit_code"] == 1
+    assert any("comm excess" in r and "smoke_overlap" in r
+               for r in comm_verdict["reasons"])
+    forged_comm = json.loads(json.dumps(rep))
+    forged_comm["comm"] = {"covered": True, "legs": [
+        {"target": "smoke_overlap", "class": "halo",
+         "modeled_bytes": None, "measured_bytes": 5120.0}]}
+    forged_verdict = gate.compare_reports(rep, forged_comm)
+    assert forged_verdict["exit_code"] == 2
+    assert any("comm coverage" in r for r in forged_verdict["reasons"])
+    assert gate.main(["--baseline", report_path, "--current",
+                      comm_bad_path, "--threshold-pct", "300",
+                      "--no-comm"]) == 0
+    capsys.readouterr()
 
     # synthetic contamination burst -> invalid evidence (the detector
     # is forced on: auto-mode skips it for CPU reports, where scheduler

@@ -120,6 +120,8 @@ def test_report_schema_round_trip(tmp_path):
     rep.graph = {"t1": {"built": True}}
     rep.donation = {"donatable_bytes": 128, "aliased_bytes": 64,
                     "coverage_pct": 50.0, "wasted_bytes": 64}
+    rep.timing = {"targets": {"t1": 1.9}, "total_s": 1.9,
+                  "cache": {"builds": 1, "hits": 1}}
     assert not rep.ok
     path = rep.write(str(tmp_path / "lint_report.json"))
     loaded = LintReport.load(path)
@@ -127,6 +129,7 @@ def test_report_schema_round_trip(tmp_path):
     assert [v.to_dict() for v in loaded.violations] \
         == [v.to_dict() for v in rep.violations]
     assert loaded.graph == rep.graph
+    assert loaded.timing == rep.timing
     assert not loaded.ok
     # unknown schema versions are refused, not misread
     bad = rep.to_dict()
@@ -198,6 +201,184 @@ def test_audit_catches_seeded_graph_hazards():
     assert any(v.checker == "host" for v in by_name["callback_step"])
 
 
+# -- dataflow tier ---------------------------------------------------------
+
+# a hand-written debug-info StableHLO module exercising every
+# precision-flow rule: %2 narrows under a plain scope (rule 1 fires),
+# %3 under the registered carry scope and %4 under a kernel-dispatch
+# scope (both sanctioned), %5 adds in bf16 (rule 2), %6 reduces with a
+# bf16 accumulator (rule 2, accumulation), %7 moves the acc-role bf16
+# value onward (rule 3)
+_DF_ASM = """\
+#loc3 = loc("jit(f)/jit(main)/rk_carry_math/convert_element_type")
+#loc4 = loc("jit(f)/jit(main)/carry_quantize/convert_element_type")
+#loc5 = loc("jit(f)/jit(main)/pallas_stencil/while/body/convert_element_type")
+#loc6 = loc("jit(f)/jit(main)/rk_stage/add")
+#loc7 = loc("jit(f)/jit(main)/energy/reduce")
+#loc8 = loc("jit(f)/jit(main)/energy/broadcast_in_dim")
+module @jit_f {
+  func.func public @main(%arg0: tensor<64x64xf32>) -> (tensor<8x8xbf16>) {
+    %0 = stablehlo.constant dense<2.000000e+00> : tensor<8x8xf32>
+    %1 = stablehlo.multiply %arg0, %0 : tensor<8x8xf32>
+    %2 = stablehlo.convert %1 : (tensor<8x8xf32>) -> tensor<8x8xbf16> loc(#loc3)
+    %3 = stablehlo.convert %1 : (tensor<8x8xf32>) -> tensor<8x8xbf16> loc(#loc4)
+    %4 = stablehlo.convert %1 : (tensor<8x8xf32>) -> tensor<8x8xbf16> loc(#loc5)
+    %5 = stablehlo.add %3, %4 : tensor<8x8xbf16> loc(#loc6)
+    %6 = stablehlo.reduce(%1 init: %0) applies stablehlo.add across dimensions = [0, 1] : (tensor<8x8xbf16>, tensor<bf16>) -> tensor<bf16> loc(#loc7)
+    %7 = stablehlo.broadcast_in_dim %6, dims = [] : (tensor<bf16>) -> tensor<8x8xbf16> loc(#loc8)
+    return %5 : tensor<8x8xbf16>
+  }
+}
+"""
+
+# a hand-written compiled-HLO body: one halo permute, one scalar
+# all-reduce, one transpose, one async all-reduce pair (the -done leg
+# must not double-count), and one field-sized all-gather (the @main
+# param above is 64x64xf32 = 16,384 B, so the replication threshold is
+# 8,192 B and the 16,384 B gather classifies as replication)
+_DF_HLO = """\
+HloModule jit_f
+ENTRY main {
+  %cp = f32[16,64]{1,0} collective-permute(f32[16,64]{1,0} %x), channel_id=1, metadata={op_name="jit(f)/jit(main)/halo_exchange/ppermute"}
+  %ar = f32[] all-reduce(f32[] %z), to_apply=%sum, metadata={op_name="jit(f)/jit(main)/energy/sum"}
+  %ars = f32[32,4]{1,0} all-reduce-start(f32[32,4]{1,0} %q), to_apply=%sum, metadata={op_name="jit(f)/jit(main)/energy/psum"}
+  %ard = f32[32,4]{1,0} all-reduce-done(f32[32,4]{1,0} %ars)
+  %a2a = f32[32,64]{1,0} all-to-all(f32[32,64]{1,0} %w), dimensions={0}, metadata={op_name="jit(f)/jit(main)/fft_transpose/all_to_all"}
+  %ag = f32[64,64]{1,0} all-gather(f32[16,64]{1,0} %y), dimensions={0}, metadata={op_name="jit(f)/jit(main)/replicate_field/all_gather"}
+}
+"""
+
+
+def test_dataflow_parse_ops():
+    from pystella_tpu.lint import dataflow
+    ops = {o["result"]: o for o in dataflow.parse_ops(_DF_ASM)}
+    assert ops["1"]["op"] == "stablehlo.multiply"
+    assert ops["1"]["out_elt"] == "f32" and ops["1"]["scope"] == ""
+    cv = ops["2"]
+    assert cv["op"] == "stablehlo.convert"
+    assert cv["in_elts"] == ["f32"] and cv["out_elt"] == "bf16"
+    assert cv["operands"] == ["1"]
+    assert cv["scope"].endswith("rk_carry_math/convert_element_type")
+    assert "carry_quantize" in ops["3"]["scope"]
+    assert ops["6"]["op"] == "stablehlo.reduce"
+
+
+def test_precision_flow_rules():
+    from pystella_tpu.lint import dataflow
+    violations, stats = dataflow.audit_precision(
+        "syn", _DF_ASM, policy=lint_graph.POLICY_BF16_ACC32)
+    msgs = [v.message for v in violations]
+    # rule 1: the rk_carry_math narrowing is named; the carry_quantize
+    # and pallas_stencil narrowings are sanctioned
+    r1 = [m for m in msgs if "downcast outside a registered carry" in m]
+    assert len(r1) == 1 and "rk_carry_math" in r1[0]
+    assert stats["carry_converts"] == 1
+    assert stats["kernel_converts"] == 1
+    # rule 2: bf16 add and the bf16-accumulator reduce
+    assert any("arithmetic in bf16 (add)" in m for m in msgs)
+    assert any("accumulation in bf16 (reduce)" in m for m in msgs)
+    # rule 3: the broadcast of the acc-role bf16 value
+    assert any("accumulation chain continues in bf16" in m
+               for m in msgs)
+    assert stats["ok"] is False and stats["reduces"] == 1
+    assert stats["policy"] == "bf16-in/f32-acc"
+
+
+def test_precision_flow_clean_without_narrowing():
+    from pystella_tpu.lint import dataflow
+    clean = "\n".join(l for l in _DF_ASM.splitlines()
+                      if "bf16" not in l)
+    violations, stats = dataflow.audit_precision("syn", clean)
+    assert violations == [] and stats["ok"] is True
+
+
+def test_static_comm_model():
+    from pystella_tpu.lint import dataflow
+    violations, block = dataflow.model_comm("syn", _DF_ASM, _DF_HLO)
+    assert block["modeled"] is True
+    assert block["field_bytes"] == 16384
+    assert block["replication_threshold"] == 8192
+    per = block["per_invocation_bytes"]
+    assert per["halo"] == 16 * 64 * 4
+    assert per["transpose"] == 32 * 64 * 4
+    # the plain all-reduce plus the async pair counted ONCE
+    assert per["scalar"] == 4 + 32 * 4 * 4
+    assert per["replication"] == 64 * 64 * 4
+    # the field-sized gather is an error naming its op_name scope
+    assert len(violations) == 1
+    assert violations[0].checker == "static-comm"
+    assert "replicate_field" in violations[0].message
+    rows = {(e["op"], e["class"]): e for e in block["collectives"]}
+    assert rows[("all-reduce", "scalar")]["count"] == 2
+    assert rows[("collective-permute", "halo")]["scopes"] \
+        == ["jit(f)/jit(main)/halo_exchange/ppermute"]
+
+
+def test_dataflow_catches_seeded_fixtures():
+    """The two new seeded fixtures through the real build path: the
+    mid-chain downcast violates precision-flow naming its scope, and
+    the field-sized all-gather violates static-comm DESPITE its base
+    op being allowlisted in the target."""
+    import lint_fixture_targets as fx
+    targets = [t for t in fx.TARGETS
+               if t.name in ("bf16_downcast_step", "replicating_gather")]
+    violations, per_target = lint.audit_dataflow_targets(targets)
+    pf = [v for v in violations if v.checker == "precision-flow"]
+    assert pf and any("rk_carry_math" in v.message for v in pf)
+    sc = [v for v in violations if v.checker == "static-comm"]
+    assert sc and any("replicate_field" in v.message for v in sc)
+    blk = per_target["replicating_gather"]["static_comm"]
+    assert blk["per_invocation_bytes"].get("replication")
+    assert per_target["bf16_downcast_step"]["precision"]["ok"] is False
+
+
+@pytest.mark.slow  # interpret-mode pallas build; the CLI acceptance
+# run (test_cli_clean_repo) covers the same verdict
+def test_bf16_chunk_target_flow_clean():
+    """The positive pin of the tentpole: the streaming-chunk program
+    built with carry_dtype=bf16 PASSES POLICY_BF16_ACC32 as a flow
+    property — every narrowing is attributed to the carry funnel, no
+    arithmetic runs narrow."""
+    from pystella_tpu.lint.targets import targets_by_name
+    t = targets_by_name(["bf16_chunk_multi_step"])["bf16_chunk_multi_step"]
+    violations, per_target = lint.audit_dataflow_targets([t])
+    assert violations == [], "\n".join(str(v) for v in violations)
+    st = per_target["bf16_chunk_multi_step"]["precision"]
+    assert st["ok"] and st["narrow_values"] > 0
+    assert st["kernel_converts"] + st["carry_converts"] > 0
+
+
+def test_targets_by_name_selection():
+    from pystella_tpu.lint.__main__ import _load_targets
+    ts = _load_targets("step_generic,mg_smooth")
+    assert [t.name for t in ts] == ["step_generic", "mg_smooth"]
+    with pytest.raises(KeyError):
+        _load_targets("bogus_target")
+
+
+def test_run_lint_no_dataflow_and_artifact_cache():
+    """--no-dataflow semantics and the shared-artifact satellite: with
+    the dataflow tier off only the IR checks run; with it on, the
+    build is shared (one build, one reuse) and the per-target timing
+    lands in the report."""
+    import lint_fixture_targets as fx
+    targets = [t for t in fx.TARGETS if t.name == "undonated_step"]
+    rep = lint.run_lint(targets=targets, run_source=False,
+                        run_dataflow=False)
+    assert "donation" in rep.checks
+    assert "precision-flow" not in rep.checks
+    assert rep.timing["cache"] == {"builds": 1, "hits": 0}
+    # run_dataflow=None follows run_graph: both tiers share one build
+    rep2 = lint.run_lint(targets=targets, run_source=False)
+    assert "precision-flow" in rep2.checks
+    assert "static-comm" in rep2.checks
+    assert rep2.timing["cache"] == {"builds": 1, "hits": 1}
+    tgt = rep2.graph["undonated_step"]
+    assert "precision" in tgt and "static_comm" in tgt
+    assert tgt["timing"]["audits"].get("precision-flow") is not None
+    assert rep2.timing["targets"]["undonated_step"] > 0
+
+
 # -- CLI -------------------------------------------------------------------
 
 def test_cli_source_fixture_exits_1():
@@ -230,6 +411,11 @@ def test_cli_graph_fixture_exits_1():
     assert "donation miss" in res.stdout
     assert "f64" in res.stdout
     assert "host interaction" in res.stdout
+    # the dataflow-tier seeds: the mid-chain downcast names its scope,
+    # the allowlisted-but-field-sized gather is caught by bytes
+    assert "rk_carry_math" in res.stdout
+    assert "replicate_field" in res.stdout
+    assert "accidental replication" in res.stdout
 
 
 @pytest.mark.slow
@@ -248,9 +434,28 @@ def test_cli_clean_repo():
     assert set(rep["graph"]) == {"step_generic", "step_sentinel",
                                  "fused_multi_step",
                                  "coupled_multi_step", "mg_smooth",
-                                 "chunk_multi_step",
+                                 "chunk_multi_step", "bf16_chunk_multi_step",
                                  "ensemble_step", "sharded_spectra"}
     assert rep["summary"]["donation"]["coverage_pct"] == 100.0
+    # the dataflow tier ran on every target: the bf16-carry program
+    # passes POLICY_BF16_ACC32 as a flow property, the artifact cache
+    # built each target exactly once, per-target timing is recorded
+    assert "precision-flow" in rep["summary"]["checks"]
+    assert "static-comm" in rep["summary"]["checks"]
+    bf16 = rep["graph"]["bf16_chunk_multi_step"]
+    assert bf16["precision"]["ok"] is True
+    assert bf16["precision"]["policy"] == "bf16-in/f32-acc"
+    assert bf16["precision"]["kernel_converts"] \
+        + bf16["precision"]["carry_converts"] > 0
+    timing = rep["summary"]["timing"]
+    assert timing["cache"]["builds"] == 9
+    assert timing["cache"]["hits"] == 9
+    assert set(timing["targets"]) == set(rep["graph"])
+    # the sharded targets carry a sensible static comm model
+    sc = rep["graph"]["step_sentinel"]["static_comm"]
+    assert sc["modeled"] and "halo" in sc["per_invocation_bytes"]
+    assert rep["graph"]["sharded_spectra"]["static_comm"][
+        "per_invocation_bytes"].get("transpose")
 
 
 # -- donation satellite ----------------------------------------------------
